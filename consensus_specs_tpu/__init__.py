@@ -1,0 +1,12 @@
+"""consensus_specs_tpu — a TPU-native executable beacon-chain specification.
+
+A ground-up re-design of the capabilities of ethereum/consensus-specs (2019
+snapshot): SSZ typing/serialization/Merkleization, the phase-0 state
+transition, phase-1 custody game and shard chains, fork choice, presets, and a
+dual-use test/vector-generation framework — with the numerically heavy kernels
+(SHA-256 Merkleization, swap-or-not shuffling, BLS12-381 aggregate
+verification, epoch reward loops) implemented as jit/vmap'd JAX array programs
+for TPU.
+"""
+
+__version__ = "0.1.0"

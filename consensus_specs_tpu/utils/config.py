@@ -1,0 +1,93 @@
+"""Constant-preset system: immutable config objects loaded from YAML.
+
+The reference re-executes generated SSZ class definitions when a preset is
+applied (`apply_constants_preset` + `init_SSZ_types`,
+/root/reference scripts/build_spec.py:108-144). Here a preset is a frozen
+mapping; spec objects (types whose Vector lengths depend on constants, and the
+functions that close over them) are built per-preset by the spec factory and
+cached, so two presets coexist as two compiled programs instead of mutated
+module globals.
+
+Capability parity: /root/reference test_libs/config_helpers/preset_loader/loader.py:10-25,
+configs/constant_presets/{mainnet,minimal}.yaml.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import yaml
+
+_CONFIG_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "configs")
+
+
+class Preset:
+    """Frozen namespace of protocol constants. `cfg.SLOTS_PER_EPOCH` etc."""
+
+    def __init__(self, name: str, constants: Dict[str, Any]):
+        object.__setattr__(self, "name", name)
+        object.__setattr__(self, "_constants", dict(constants))
+        for k, v in constants.items():
+            object.__setattr__(self, k, v)
+
+    def __setattr__(self, key: str, value: Any):
+        raise AttributeError("Preset is immutable")
+
+    def __getitem__(self, key: str) -> Any:
+        return self._constants[key]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._constants
+
+    def keys(self):
+        return self._constants.keys()
+
+    def items(self):
+        return self._constants.items()
+
+    def replace(self, **overrides: Any) -> "Preset":
+        merged = dict(self._constants)
+        merged.update(overrides)
+        return Preset(f"{self.name}+custom", merged)
+
+    def __repr__(self):
+        return f"Preset({self.name!r}, {len(self._constants)} constants)"
+
+
+def _parse_value(key: str, value: Any) -> Any:
+    if isinstance(value, str) and value.startswith("0x"):
+        return bytes.fromhex(value[2:])
+    if isinstance(value, int):
+        return value
+    return value
+
+
+def load_preset_file(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        raw = yaml.safe_load(f)
+    return {k: _parse_value(k, v) for k, v in raw.items()}
+
+
+_preset_cache: Dict[str, Preset] = {}
+
+
+def load_preset(name_or_path: str) -> Preset:
+    """Load a preset by name ('mainnet'/'minimal') or explicit YAML path."""
+    if name_or_path in _preset_cache:
+        return _preset_cache[name_or_path]
+    path = name_or_path
+    name = os.path.splitext(os.path.basename(path))[0]
+    if not os.path.exists(path):
+        path = os.path.join(_CONFIG_DIR, f"{name_or_path}.yaml")
+        name = name_or_path
+    preset = Preset(name, load_preset_file(path))
+    _preset_cache[name_or_path] = preset
+    return preset
+
+
+def mainnet() -> Preset:
+    return load_preset("mainnet")
+
+
+def minimal() -> Preset:
+    return load_preset("minimal")

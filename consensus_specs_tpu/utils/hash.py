@@ -1,0 +1,64 @@
+"""SHA-256 hashing with a zero-subtree cache and a pluggable batch backend.
+
+Host path uses hashlib (OpenSSL). The TPU path (consensus_specs_tpu.ops.sha256)
+registers a batched hasher used by Merkleization to hash whole tree levels at
+once instead of chunk-by-chunk.
+
+Capability parity: /root/reference test_libs/pyspec/eth2spec/utils/hash_function.py:1-29
+(re-designed: batch boundary added so Merkle levels can be hashed on-device).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, List
+
+ZERO_BYTES32 = b"\x00" * 32
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def hash_eth2(data: bytes) -> bytes:
+    """The spec's `hash` function: SHA-256."""
+    return sha256(data)
+
+
+# ---------------------------------------------------------------------------
+# Batched hashing boundary.
+#
+# A "pair hasher" maps a list of 64-byte inputs to a list of 32-byte digests.
+# Merkleization calls this once per tree level; backends may vectorize.
+# ---------------------------------------------------------------------------
+
+def _host_hash_pairs(blocks: List[bytes]) -> List[bytes]:
+    h = hashlib.sha256
+    return [h(b).digest() for b in blocks]
+
+
+_pair_hasher: Callable[[List[bytes]], List[bytes]] = _host_hash_pairs
+
+
+def set_pair_hasher(fn: Callable[[List[bytes]], List[bytes]]) -> None:
+    """Install a batched 64B->32B hasher (e.g. the JAX/TPU kernel)."""
+    global _pair_hasher
+    _pair_hasher = fn
+
+
+def get_pair_hasher() -> Callable[[List[bytes]], List[bytes]]:
+    return _pair_hasher
+
+
+def hash_pairs(blocks: List[bytes]) -> List[bytes]:
+    """Hash many 64-byte blocks (one Merkle level) with the active backend."""
+    return _pair_hasher(blocks)
+
+
+# ---------------------------------------------------------------------------
+# Zero-subtree hashes: zerohashes[i] = root of a depth-i tree of zero chunks.
+# ---------------------------------------------------------------------------
+
+_MAX_ZERO_DEPTH = 64
+zerohashes: List[bytes] = [ZERO_BYTES32]
+for _ in range(_MAX_ZERO_DEPTH):
+    zerohashes.append(sha256(zerohashes[-1] + zerohashes[-1]))

@@ -1,0 +1,26 @@
+"""Test-wide setup: run JAX on a virtual 8-device CPU mesh.
+
+Must run before any jax import, so it lives at the top of conftest.
+Bench/production paths use the real TPU; tests validate sharding logic on
+virtual devices per the multi-chip test strategy.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--preset", action="store", default="minimal",
+        help="constant preset to run spec tests under (minimal/mainnet)",
+    )
+
+
+@pytest.fixture(scope="session")
+def preset_name(request):
+    return request.config.getoption("--preset")

@@ -1,0 +1,28 @@
+from consensus_specs_tpu.utils.config import load_preset, mainnet, minimal
+
+
+def test_load_presets():
+    mn, ml = mainnet(), minimal()
+    assert mn.SLOTS_PER_EPOCH == 64
+    assert ml.SLOTS_PER_EPOCH == 8
+    assert mn.SHUFFLE_ROUND_COUNT == 90
+    assert ml.SHUFFLE_ROUND_COUNT == 10
+    assert mn.FAR_FUTURE_EPOCH == 2 ** 64 - 1
+    assert mn.GENESIS_FORK_VERSION == b"\x00" * 4
+
+
+def test_preset_immutable_and_replace():
+    ml = minimal()
+    try:
+        ml.SLOTS_PER_EPOCH = 4
+        raised = False
+    except AttributeError:
+        raised = True
+    assert raised
+    custom = ml.replace(SLOTS_PER_EPOCH=4)
+    assert custom.SLOTS_PER_EPOCH == 4
+    assert minimal().SLOTS_PER_EPOCH == 8
+
+
+def test_preset_cached():
+    assert load_preset("minimal") is load_preset("minimal")

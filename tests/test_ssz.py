@@ -1,0 +1,230 @@
+"""SSZ typing/serialization/Merkleization unit tests.
+
+Known-answer vectors are computed from the 2019 SSZ rules
+(/root/reference specs/simple-serialize.md): little-endian uints, 4-byte
+offsets for variable-size parts, pow2-padded Merkleization, mix_in_length
+for lists, truncated signing_root.
+"""
+import hashlib
+
+import pytest
+
+from consensus_specs_tpu.utils.ssz import (
+    Bytes32, Bytes48, Bytes96, Container, List, Vector,
+    uint8, uint16, uint32, uint64, uint128, uint256,
+    serialize, deserialize, hash_tree_root, signing_root,
+    get_zero_value, is_fixed_size,
+)
+from consensus_specs_tpu.utils.merkle import merkleize_chunks, next_power_of_two
+from consensus_specs_tpu.utils.hash import zerohashes, ZERO_BYTES32
+
+
+def h(x: bytes) -> bytes:
+    return hashlib.sha256(x).digest()
+
+
+class Point(Container):
+    x: uint64
+    y: uint64
+
+
+class Signed(Container):
+    value: uint64
+    sig: Bytes96
+
+
+class VarBox(Container):
+    tag: uint8
+    items: List[uint64]
+
+
+# ---------------------------------------------------------------- serialization
+
+def test_serialize_uints():
+    assert serialize(uint8(5)) == b"\x05"
+    assert serialize(uint16(0x0102)) == b"\x02\x01"
+    assert serialize(uint32(1)) == b"\x01\x00\x00\x00"
+    assert serialize(5, uint64) == (5).to_bytes(8, "little")
+    assert serialize(uint256(1)) == b"\x01" + b"\x00" * 31
+    assert serialize(uint128(2 ** 127)) == b"\x00" * 15 + b"\x80"
+
+
+def test_uint_bounds():
+    with pytest.raises(ValueError):
+        uint8(256)
+    with pytest.raises(ValueError):
+        uint64(-1)
+    with pytest.raises(ValueError):
+        uint64(2 ** 64)
+
+
+def test_serialize_bool():
+    assert serialize(True, bool) == b"\x01"
+    assert serialize(False, bool) == b"\x00"
+
+
+def test_serialize_fixed_container():
+    p = Point(x=1, y=2)
+    assert serialize(p) == (1).to_bytes(8, "little") + (2).to_bytes(8, "little")
+    assert is_fixed_size(Point)
+
+
+def test_serialize_variable_container():
+    b = VarBox(tag=7, items=[1, 2, 3])
+    # fixed region: tag (1 byte) + offset (4 bytes) = 5; items start at 5
+    expected = b"\x07" + (5).to_bytes(4, "little") + b"".join(
+        i.to_bytes(8, "little") for i in (1, 2, 3))
+    assert serialize(b) == expected
+    assert not is_fixed_size(VarBox)
+
+
+def test_serialize_vector_and_bytes():
+    V = Vector[uint16, 3]
+    assert serialize(V(1, 2, 3)) == b"\x01\x00\x02\x00\x03\x00"
+    assert serialize(Bytes32()) == b"\x00" * 32
+    assert serialize(b"\xab\xcd", bytes) == b"\xab\xcd"
+
+
+def test_serialize_list_of_containers():
+    LP = List[Point]
+    data = serialize([Point(x=1, y=2), Point(x=3, y=4)], LP)
+    # fixed-size elements inline, no offsets
+    assert data == serialize(Point(x=1, y=2)) + serialize(Point(x=3, y=4))
+
+
+def test_list_of_variable_elements():
+    LL = List[List[uint64]]
+    data = serialize([[1], [2, 3]], LL)
+    # two offsets (8 bytes), then 8 bytes, then 16 bytes
+    assert data[:4] == (8).to_bytes(4, "little")
+    assert data[4:8] == (16).to_bytes(4, "little")
+    assert deserialize(data, LL) == [[1], [2, 3]]
+
+
+# ------------------------------------------------------------- deserialization
+
+@pytest.mark.parametrize("obj,typ", [
+    (uint64(12345), uint64),
+    (Point(x=9, y=10), Point),
+    (VarBox(tag=1, items=[5, 6, 7, 8]), VarBox),
+    (Signed(value=3, sig=Bytes96(b"\x11" * 96)), Signed),
+])
+def test_roundtrip(obj, typ):
+    data = serialize(obj, typ)
+    back = deserialize(data, typ)
+    assert serialize(back, typ) == data
+    assert hash_tree_root(back, typ) == hash_tree_root(obj, typ)
+
+
+def test_roundtrip_nested():
+    class Outer(Container):
+        p: Point
+        boxes: List[VarBox]
+        roots: Vector[Bytes32, 2]
+
+    o = Outer(p=Point(x=1, y=2),
+              boxes=[VarBox(tag=3, items=[4]), VarBox(tag=5, items=[])],
+              roots=Vector[Bytes32, 2](Bytes32(b"\x01" * 32), Bytes32(b"\x02" * 32)))
+    back = deserialize(serialize(o), Outer)
+    assert back == o
+
+
+# --------------------------------------------------------------- merkleization
+
+def test_next_power_of_two():
+    assert [next_power_of_two(i) for i in (0, 1, 2, 3, 4, 5, 8, 9)] == [1, 1, 2, 4, 4, 8, 8, 16]
+
+
+def test_merkleize_single_chunk():
+    c = b"\x01" * 32
+    assert merkleize_chunks([c]) == c
+
+
+def test_merkleize_two_chunks():
+    a, b = b"\x01" * 32, b"\x02" * 32
+    assert merkleize_chunks([a, b]) == h(a + b)
+
+
+def test_merkleize_three_chunks_pads():
+    a, b, c = b"\x01" * 32, b"\x02" * 32, b"\x03" * 32
+    assert merkleize_chunks([a, b, c]) == h(h(a + b) + h(c + ZERO_BYTES32))
+
+
+def test_merkleize_empty():
+    assert merkleize_chunks([]) == ZERO_BYTES32
+
+
+def test_zerohashes_chain():
+    assert zerohashes[1] == h(ZERO_BYTES32 + ZERO_BYTES32)
+    assert zerohashes[2] == h(zerohashes[1] + zerohashes[1])
+
+
+def test_htr_uint():
+    assert hash_tree_root(uint64(5)) == (5).to_bytes(8, "little") + b"\x00" * 24
+
+
+def test_htr_container():
+    p = Point(x=1, y=2)
+    left = (1).to_bytes(8, "little") + b"\x00" * 24
+    right = (2).to_bytes(8, "little") + b"\x00" * 24
+    assert hash_tree_root(p) == h(left + right)
+
+
+def test_htr_list_mixes_length():
+    root = hash_tree_root([uint64(1), uint64(2)], List[uint64])
+    packed = (1).to_bytes(8, "little") + (2).to_bytes(8, "little") + b"\x00" * 16
+    assert root == h(packed + (2).to_bytes(32, "little"))
+
+
+def test_htr_empty_list():
+    assert hash_tree_root([], List[uint64]) == h(ZERO_BYTES32 + (0).to_bytes(32, "little"))
+
+
+def test_htr_bytes32_identity_chunk():
+    b = Bytes32(b"\x05" * 32)
+    assert hash_tree_root(b) == bytes(b)
+
+
+def test_htr_bytes96():
+    b = Bytes96(b"\x01" * 96)
+    chunks = [b"\x01" * 32] * 3
+    assert hash_tree_root(b) == h(h(chunks[0] + chunks[1]) + h(chunks[2] + ZERO_BYTES32))
+
+
+def test_signing_root_drops_last_field():
+    s = Signed(value=3, sig=Bytes96(b"\xaa" * 96))
+    assert signing_root(s) == hash_tree_root(uint64(3))
+    # independent of the signature value
+    s2 = Signed(value=3, sig=Bytes96(b"\xbb" * 96))
+    assert signing_root(s2) == signing_root(s)
+
+
+# ------------------------------------------------------------------ containers
+
+def test_zero_value_defaults():
+    b = VarBox()
+    assert b.tag == 0 and b.items == []
+    assert get_zero_value(Bytes32) == b"\x00" * 32
+    assert get_zero_value(Vector[uint64, 3]).items == [0, 0, 0]
+
+
+def test_container_copy_is_deep():
+    b = VarBox(tag=1, items=[1, 2])
+    c = b.copy()
+    c.items.append(3)
+    c.tag = 9
+    assert b.items == [1, 2] and b.tag == 1
+
+
+def test_container_field_inheritance():
+    class Extended(Point):
+        z: uint64
+
+    assert Extended.get_field_names() == ["x", "y", "z"]
+    e = Extended(x=1, y=2, z=3)
+    assert serialize(e) == b"".join(i.to_bytes(8, "little") for i in (1, 2, 3))
+
+
+def test_eq_by_hash_tree_root():
+    assert Point(x=1, y=2) == Point(x=1, y=2)
+    assert Point(x=1, y=2) != Point(x=2, y=1)
