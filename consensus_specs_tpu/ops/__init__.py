@@ -1,0 +1,1 @@
+"""TPU array kernels: batched SHA-256, swap-or-not shuffle, BLS12-381 field ops."""

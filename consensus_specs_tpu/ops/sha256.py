@@ -1,0 +1,246 @@
+"""Batched SHA-256 as JAX uint32 array code.
+
+The reference hashes one 64-byte block at a time through OpenSSL
+(/root/reference test_libs/pyspec/eth2spec/utils/hash_function.py:1-29) and
+Merkleizes level-by-level with a Python loop
+(/root/reference test_libs/pyspec/eth2spec/utils/merkle_minimal.py:47-54).
+Here the unit of work is a *batch*: an [N, 16] uint32 array of message blocks
+compressed in one traced program, so a whole Merkle tree level (or all 90
+shuffle-round hashes for every index at once) is a single XLA op stream on the
+VPU. All lanes run the same 64 unrolled rounds — no data-dependent control
+flow, fixed shapes, uint32 throughout (TPU-native word size).
+
+Laid out so the hot entry points are jit-cached by shape:
+  - sha256_blocks(state [*, 8], block [*, 16])  — one compression, any batch shape
+  - sha256_pairs(words [N, 16]) -> [N, 8]       — hash N 64-byte messages (Merkle level)
+  - sha256_single_block(words [*, 16])          — hash messages <= 55 bytes already
+                                                  padded into one block (shuffle path)
+  - merkle_root_from_leaves_device(leaves)      — full tree reduction on device
+
+Host bridging helpers convert bytes <-> big-endian uint32 word arrays.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Round constants: fractional parts of cube roots of the first 64 primes.
+K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+], dtype=np.uint32)
+
+# Initial hash state: fractional parts of square roots of the first 8 primes.
+H0 = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+], dtype=np.uint32)
+
+
+def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def sha256_blocks(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    """One SHA-256 compression. state: [..., 8] uint32, block: [..., 16] uint32.
+
+    Rounds run under lax.fori_loop (loop-carried dependency chain — no
+    cross-round parallelism to lose), keeping the traced graph ~64x smaller
+    than a Python unroll; the batch dimension is where the VPU parallelism is.
+    """
+    batch = block.shape[:-1]
+    w = jnp.zeros((64,) + batch, dtype=jnp.uint32)
+    w = w.at[:16].set(jnp.moveaxis(block, -1, 0))
+
+    def sched_body(i, w):
+        x = w[i - 15]
+        y = w[i - 2]
+        s0 = _rotr(x, 7) ^ _rotr(x, 18) ^ (x >> np.uint32(3))
+        s1 = _rotr(y, 17) ^ _rotr(y, 19) ^ (y >> np.uint32(10))
+        return w.at[i].set(w[i - 16] + s0 + w[i - 7] + s1)
+
+    w = jax.lax.fori_loop(16, 64, sched_body, w)
+    k_arr = jnp.asarray(K)
+
+    def round_body(i, carry):
+        a, b, c, d, e, f, g, h = carry
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        temp1 = h + S1 + ch + k_arr[i] + w[i]
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        temp2 = S0 + maj
+        return (temp1 + temp2, a, b, c, d + temp1, e, f, g)
+
+    init = tuple(state[..., i] for i in range(8))
+    out = jax.lax.fori_loop(0, 64, round_body, init)
+    return state + jnp.stack(out, axis=-1)
+
+
+def _padding_block_for_length(message_bytes: int) -> np.ndarray:
+    """The final all-padding block for a message that exactly fills prior blocks."""
+    assert message_bytes % 64 == 0
+    blk = np.zeros(16, dtype=np.uint32)
+    blk[0] = 0x80000000
+    bitlen = message_bytes * 8
+    blk[14] = (bitlen >> 32) & 0xFFFFFFFF
+    blk[15] = bitlen & 0xFFFFFFFF
+    return blk
+
+_PAD_64 = _padding_block_for_length(64)  # padding block for 64-byte messages
+
+
+@jax.jit
+def sha256_pairs(words: jnp.ndarray) -> jnp.ndarray:
+    """Hash N 64-byte messages given as [N, 16] uint32 (big-endian words) -> [N, 8].
+
+    This is the Merkle work-horse: each lane is `sha256(left ‖ right)`.
+    Two compressions: the data block, then the constant padding block.
+    """
+    n = words.shape[0]
+    state = jnp.broadcast_to(jnp.asarray(H0), (n, 8))
+    state = sha256_blocks(state, words)
+    pad = jnp.broadcast_to(jnp.asarray(_PAD_64), (n, 16))
+    return sha256_blocks(state, pad)
+
+
+@jax.jit
+def sha256_single_block(words: jnp.ndarray) -> jnp.ndarray:
+    """Hash messages that (with padding) fit one block: [..., 16] uint32 -> [..., 8].
+
+    Caller must have already placed 0x80 terminator + bit length into the words
+    (see pad_to_single_block). Used by the shuffle kernel (33/37-byte inputs).
+    """
+    state = jnp.broadcast_to(jnp.asarray(H0), words.shape[:-1] + (8,))
+    return sha256_blocks(state, words)
+
+
+def pad_to_single_block(data: np.ndarray, message_bytes: int) -> np.ndarray:
+    """Pad [..., message_bytes] uint8 arrays (<=55 bytes) into [..., 16] uint32 blocks."""
+    assert message_bytes <= 55
+    padded = np.zeros(data.shape[:-1] + (64,), dtype=np.uint8)
+    padded[..., :message_bytes] = data
+    padded[..., message_bytes] = 0x80
+    bitlen = message_bytes * 8
+    padded[..., 62] = (bitlen >> 8) & 0xFF
+    padded[..., 63] = bitlen & 0xFF
+    return bytes_to_words(padded)
+
+
+# ---------------------------------------------------------------------------
+# bytes <-> big-endian uint32 word bridging
+# ---------------------------------------------------------------------------
+
+def bytes_to_words(data: np.ndarray) -> np.ndarray:
+    """[..., 4k] uint8 -> [..., k] uint32 big-endian words."""
+    assert data.dtype == np.uint8 and data.shape[-1] % 4 == 0
+    return data.reshape(data.shape[:-1] + (-1, 4)).astype(np.uint32) @ np.array(
+        [1 << 24, 1 << 16, 1 << 8, 1], dtype=np.uint32)
+
+
+def words_to_bytes(words: np.ndarray) -> np.ndarray:
+    """[..., k] uint32 -> [..., 4k] uint8 big-endian."""
+    words = np.asarray(words, dtype=np.uint32)
+    out = np.empty(words.shape + (4,), dtype=np.uint8)
+    out[..., 0] = words >> 24
+    out[..., 1] = (words >> 16) & 0xFF
+    out[..., 2] = (words >> 8) & 0xFF
+    out[..., 3] = words & 0xFF
+    return out.reshape(words.shape[:-1] + (-1,))
+
+
+def sha256_many(messages: np.ndarray) -> np.ndarray:
+    """Hash a batch of equal-length byte messages on device.
+
+    messages: [N, L] uint8. Returns [N, 32] uint8. Handles arbitrary L by
+    building the standard padded multi-block layout and compressing each block
+    in sequence (block count is static — derived from L).
+    """
+    n, length = messages.shape
+    n_blocks = (length + 9 + 63) // 64
+    padded = np.zeros((n, n_blocks * 64), dtype=np.uint8)
+    padded[:, :length] = messages
+    padded[:, length] = 0x80
+    bitlen = length * 8
+    bl = np.frombuffer(bitlen.to_bytes(8, "big"), dtype=np.uint8)
+    padded[:, -8:] = bl
+    words = bytes_to_words(padded).reshape(n, n_blocks, 16)
+    state = _sha256_multiblock(jnp.asarray(words))
+    return words_to_bytes(np.asarray(state))
+
+
+@jax.jit
+def _sha256_multiblock(words: jnp.ndarray) -> jnp.ndarray:
+    n, n_blocks, _ = words.shape
+    state = jnp.broadcast_to(jnp.asarray(H0), (n, 8))
+    for i in range(n_blocks):  # static unroll: block count fixed by shape
+        state = sha256_blocks(state, words[:, i, :])
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Device-side Merkle reduction
+# ---------------------------------------------------------------------------
+
+def merkle_root_device(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
+    """Root of a power-of-two tree over [N, 8]-word leaves, N == 2**depth.
+
+    Host loop over levels; each level is one call into the jitted pair hash,
+    so level shapes compile once and are shared across all trees of a size.
+    """
+    level = leaves
+    for _ in range(depth):
+        blocks = level.reshape(level.shape[0] // 2, 16)
+        level = sha256_pairs(blocks)
+    return level[0]
+
+
+def merkle_root_from_leaves_device(leaves_bytes: Sequence[bytes], pad_to: int) -> bytes:
+    """Host entry: Merkle root of 32-byte leaves, zero-padded to pad_to (pow2)."""
+    from ..utils.hash import zerohashes  # local import to avoid cycle
+    n = len(leaves_bytes)
+    assert pad_to >= 1 and (pad_to & (pad_to - 1)) == 0
+    depth = (pad_to - 1).bit_length()
+    if n == 0:
+        return zerohashes[depth]
+    arr = np.zeros((pad_to, 32), dtype=np.uint8)
+    for i, leaf in enumerate(leaves_bytes):
+        arr[i] = np.frombuffer(leaf, dtype=np.uint8)
+    words = jnp.asarray(bytes_to_words(arr))
+    root = merkle_root_device(words, depth)
+    return words_to_bytes(np.asarray(root)).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Pluggable pair-hasher backend for utils.hash (host bytes in/out)
+# ---------------------------------------------------------------------------
+
+_DEVICE_MIN_BATCH = 256  # below this, OpenSSL beats the dispatch overhead
+
+
+def jax_pair_hasher(blocks: List[bytes]) -> List[bytes]:
+    """Drop-in for utils.hash.hash_pairs: batch 64-byte inputs onto the device."""
+    if len(blocks) < _DEVICE_MIN_BATCH:
+        from ..utils.hash import _host_hash_pairs
+        return _host_hash_pairs(blocks)
+    arr = np.frombuffer(b"".join(blocks), dtype=np.uint8).reshape(len(blocks), 64)
+    digests = sha256_pairs(jnp.asarray(bytes_to_words(arr)))
+    out = words_to_bytes(np.asarray(digests))
+    return [out[i].tobytes() for i in range(len(blocks))]
+
+
+def install_device_hasher() -> None:
+    from ..utils.hash import set_pair_hasher
+    set_pair_hasher(jax_pair_hasher)

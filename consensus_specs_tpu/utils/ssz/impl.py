@@ -148,8 +148,9 @@ def _decode_homogeneous(data: bytes, elem_type: Any, count: Any) -> PyList[Any]:
     n = first // BYTES_PER_LENGTH_OFFSET
     if count is not None:
         assert n == count, f"expected {count} elements, got {n}"
-    offsets = [int.from_bytes(data[i * 4:i * 4 + 4], "little") for i in range(n)] + [len(data)]
-    assert offsets[0] == n * 4, "offset table size mismatch"
+    w = BYTES_PER_LENGTH_OFFSET
+    offsets = [int.from_bytes(data[i * w:(i + 1) * w], "little") for i in range(n)] + [len(data)]
+    assert offsets[0] == n * w, "offset table size mismatch"
     for i in range(n):
         assert offsets[i] <= offsets[i + 1], "offsets not monotonic"
     return [deserialize(data[offsets[i]:offsets[i + 1]], elem_type) for i in range(n)]

@@ -24,10 +24,12 @@ class uint(int):
     byte_len = 0
 
     def __new__(cls, value: int = 0):
+        if cls.byte_len == 0:
+            raise TypeError("bare uint is abstract; use uint8..uint256")
         value = int(value)
         if value < 0:
             raise ValueError(f"{cls.__name__} must be non-negative")
-        if cls.byte_len and value.bit_length() > cls.byte_len * 8:
+        if value.bit_length() > cls.byte_len * 8:
             raise ValueError(f"value out of bounds for {cls.__name__}")
         return super().__new__(cls, value)
 
@@ -67,6 +69,8 @@ def is_uint_type(typ: Any) -> bool:
 
 def uint_byte_size(typ: Any) -> int:
     if isinstance(typ, type) and issubclass(typ, uint):
+        if typ.byte_len == 0:
+            raise TypeError("bare uint is abstract; use uint8..uint256")
         return typ.byte_len
     if isinstance(typ, type) and issubclass(typ, int):
         return 8  # bare int defaults to uint64
@@ -235,11 +239,23 @@ class Container:
 
     @classmethod
     def get_fields(cls) -> PyList[Tuple[str, Any]]:
+        cached = cls.__dict__.get("_fields_cache")
+        if cached is not None:
+            return cached
         # walk the MRO so phase-1 containers can append fields via subclassing
         fields: Dict[str, Any] = {}
         for klass in reversed(cls.__mro__):
-            fields.update(getattr(klass, "__annotations__", {}))
-        return list(fields.items())
+            for name, typ in getattr(klass, "__annotations__", {}).items():
+                if isinstance(typ, str):
+                    # PEP 563 stringified annotation: resolve against the
+                    # defining module so `from __future__ import annotations`
+                    # spec modules still work.
+                    import sys
+                    typ = eval(typ, vars(sys.modules[klass.__module__]))  # noqa: S307
+                fields[name] = typ
+        result = list(fields.items())
+        cls._fields_cache = result
+        return result
 
     @classmethod
     def get_field_names(cls) -> PyList[str]:

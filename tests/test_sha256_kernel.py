@@ -1,0 +1,54 @@
+"""Batched SHA-256 kernel vs hashlib ground truth."""
+import hashlib
+
+import numpy as np
+
+from consensus_specs_tpu.ops import sha256 as k
+from consensus_specs_tpu.utils.merkle import merkleize_chunks
+from consensus_specs_tpu.utils.hash import zerohashes
+
+
+def test_pair_hash_matches_hashlib():
+    rng = np.random.default_rng(0)
+    blocks = [rng.integers(0, 256, 64, dtype=np.uint8).tobytes() for _ in range(300)]
+    got = k.jax_pair_hasher(blocks)
+    want = [hashlib.sha256(b).digest() for b in blocks]
+    assert got == want
+
+
+def test_sha256_many_various_lengths():
+    rng = np.random.default_rng(1)
+    for length in (1, 33, 37, 55, 56, 64, 65, 100, 128, 200):
+        msgs = rng.integers(0, 256, (5, length), dtype=np.uint8)
+        got = k.sha256_many(msgs)
+        for i in range(5):
+            assert got[i].tobytes() == hashlib.sha256(msgs[i].tobytes()).digest(), length
+
+
+def test_single_block_padding():
+    rng = np.random.default_rng(2)
+    for length in (1, 33, 37, 55):
+        msgs = rng.integers(0, 256, (4, length), dtype=np.uint8)
+        words = k.pad_to_single_block(msgs, length)
+        digests = k.words_to_bytes(np.asarray(k.sha256_single_block(words)))
+        for i in range(4):
+            assert digests[i].tobytes() == hashlib.sha256(msgs[i].tobytes()).digest()
+
+
+def test_device_merkle_root_matches_host():
+    rng = np.random.default_rng(3)
+    for n, pad_to in ((1, 1), (3, 4), (8, 8), (5, 16), (100, 128)):
+        leaves = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes() for _ in range(n)]
+        got = k.merkle_root_from_leaves_device(leaves, pad_to)
+        padded = leaves + [b"\x00" * 32] * (pad_to - n)
+        assert got == merkleize_chunks(padded)
+
+
+def test_device_merkle_empty():
+    assert k.merkle_root_from_leaves_device([], 8) == zerohashes[3]
+
+
+def test_words_roundtrip():
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 256, (7, 64), dtype=np.uint8)
+    assert np.array_equal(k.words_to_bytes(k.bytes_to_words(data)), data)
