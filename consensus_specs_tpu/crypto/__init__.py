@@ -1,0 +1,1 @@
+"""Crypto backends: the BLS12-381 swap boundary (stub / pure-python / JAX-TPU)."""

@@ -1,0 +1,1 @@
+"""Debug codecs: SSZ value <-> YAML/JSON-friendly encoding, random object factory."""

@@ -1,0 +1,1 @@
+"""Protocol models: phase0 beacon chain, phase1 custody game + shard chains."""

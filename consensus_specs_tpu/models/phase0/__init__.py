@@ -1,0 +1,8 @@
+"""Phase-0 beacon chain: per-preset spec objects.
+
+    from consensus_specs_tpu.models import phase0
+    spec = phase0.get_spec("minimal")
+    state = spec.get_genesis_beacon_state(...)
+    spec.state_transition(state, block)
+"""
+from .spec import Phase0Spec, get_spec  # noqa: F401

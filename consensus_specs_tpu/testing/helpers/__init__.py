@@ -1,0 +1,1 @@
+"""Object factories: valid-by-construction protocol objects for tests/vectors."""

@@ -1,0 +1,126 @@
+"""Attestation factories (reference test/helpers/attestations.py)."""
+from __future__ import annotations
+
+from typing import List
+
+from ...crypto.bls import bls_aggregate_signatures, bls_sign
+from ...utils.ssz.impl import hash_tree_root
+from .bitfields import set_bitfield_bit
+from .block import build_empty_block_for_next_slot, sign_block
+from .keys import privkeys
+
+
+def build_attestation_data(spec, state, slot, shard):
+    assert state.slot >= slot
+
+    if slot == state.slot:
+        block_root = build_empty_block_for_next_slot(spec, state).parent_root
+    else:
+        block_root = spec.get_block_root_at_slot(state, slot)
+
+    current_epoch_start_slot = spec.get_epoch_start_slot(spec.get_current_epoch(state))
+    if slot < current_epoch_start_slot:
+        epoch_boundary_root = spec.get_block_root(state, spec.get_previous_epoch(state))
+    elif slot == current_epoch_start_slot:
+        epoch_boundary_root = block_root
+    else:
+        epoch_boundary_root = spec.get_block_root(state, spec.get_current_epoch(state))
+
+    if slot < current_epoch_start_slot:
+        justified_epoch = state.previous_justified_epoch
+        justified_block_root = state.previous_justified_root
+    else:
+        justified_epoch = state.current_justified_epoch
+        justified_block_root = state.current_justified_root
+
+    if spec.slot_to_epoch(slot) == spec.get_current_epoch(state):
+        parent_crosslink = state.current_crosslinks[shard]
+    else:
+        parent_crosslink = state.previous_crosslinks[shard]
+
+    return spec.AttestationData(
+        beacon_block_root=block_root,
+        source_epoch=justified_epoch,
+        source_root=justified_block_root,
+        target_epoch=spec.slot_to_epoch(slot),
+        target_root=epoch_boundary_root,
+        crosslink=spec.Crosslink(
+            shard=shard,
+            start_epoch=parent_crosslink.end_epoch,
+            end_epoch=min(spec.slot_to_epoch(slot), parent_crosslink.end_epoch + spec.MAX_EPOCHS_PER_CROSSLINK),
+            data_root=spec.ZERO_HASH,
+            parent_root=hash_tree_root(parent_crosslink),
+        ),
+    )
+
+
+def get_valid_attestation(spec, state, slot=None, signed=False):
+    if slot is None:
+        slot = state.slot
+
+    epoch = spec.slot_to_epoch(slot)
+    epoch_start_shard = spec.get_epoch_start_shard(state, epoch)
+    committees_per_slot = spec.get_epoch_committee_count(state, epoch) // spec.SLOTS_PER_EPOCH
+    shard = (epoch_start_shard + committees_per_slot * (slot % spec.SLOTS_PER_EPOCH)) % spec.SHARD_COUNT
+
+    attestation_data = build_attestation_data(spec, state, slot, shard)
+
+    crosslink_committee = spec.get_crosslink_committee(
+        state, attestation_data.target_epoch, attestation_data.crosslink.shard)
+
+    bitfield_length = (len(crosslink_committee) + 7) // 8
+    attestation = spec.Attestation(
+        aggregation_bitfield=b"\x00" * bitfield_length,
+        data=attestation_data,
+        custody_bitfield=b"\x00" * bitfield_length,
+    )
+    fill_aggregate_attestation(spec, state, attestation)
+    if signed:
+        sign_attestation(spec, state, attestation)
+    return attestation
+
+
+def sign_aggregate_attestation(spec, state, attestation_data, participants: List[int]):
+    signatures = [
+        get_attestation_signature(spec, state, attestation_data, privkeys[validator_index])
+        for validator_index in participants
+    ]
+    return bls_aggregate_signatures(signatures)
+
+
+def sign_indexed_attestation(spec, state, indexed_attestation):
+    participants = list(indexed_attestation.custody_bit_0_indices) + \
+        list(indexed_attestation.custody_bit_1_indices)
+    indexed_attestation.signature = sign_aggregate_attestation(
+        spec, state, indexed_attestation.data, participants)
+
+
+def sign_attestation(spec, state, attestation):
+    participants = spec.get_attesting_indices(state, attestation.data, attestation.aggregation_bitfield)
+    attestation.signature = sign_aggregate_attestation(spec, state, attestation.data, participants)
+
+
+def get_attestation_signature(spec, state, attestation_data, privkey, custody_bit=False):
+    message_hash = hash_tree_root(
+        spec.AttestationDataAndCustodyBit(data=attestation_data, custody_bit=custody_bit))
+    return bls_sign(
+        message_hash=message_hash,
+        privkey=privkey,
+        domain=spec.get_domain(state, spec.DOMAIN_ATTESTATION, message_epoch=attestation_data.target_epoch),
+    )
+
+
+def fill_aggregate_attestation(spec, state, attestation):
+    crosslink_committee = spec.get_crosslink_committee(
+        state, attestation.data.target_epoch, attestation.data.crosslink.shard)
+    for i in range(len(crosslink_committee)):
+        attestation.aggregation_bitfield = set_bitfield_bit(attestation.aggregation_bitfield, i)
+
+
+def add_attestation_to_state(spec, state, attestation, slot):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.slot = slot
+    block.body.attestations.append(attestation)
+    spec.process_slots(state, block.slot)
+    sign_block(spec, state, block)
+    spec.state_transition(state, block)

@@ -1,0 +1,8 @@
+"""Bitfield manipulation for attestation construction."""
+
+
+def set_bitfield_bit(bitfield: bytes, i: int) -> bytes:
+    byte_index, bit_index = i // 8, i % 8
+    return (bitfield[:byte_index]
+            + bytes([bitfield[byte_index] | (1 << bit_index)])
+            + bitfield[byte_index + 1:])

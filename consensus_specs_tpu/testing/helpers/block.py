@@ -1,0 +1,66 @@
+"""Block construction and signing (reference test/helpers/block.py)."""
+from __future__ import annotations
+
+from copy import deepcopy
+
+from ...crypto.bls import bls_sign
+from ...utils.ssz.impl import hash_tree_root, signing_root
+from .keys import privkeys
+
+
+def sign_block(spec, state, block, proposer_index=None):
+    from ...crypto import bls
+    if not bls.bls_active:
+        return  # proposer-index calculation is slow; skip entirely with BLS off
+
+    assert state.slot <= block.slot
+
+    if proposer_index is None:
+        if block.slot == state.slot:
+            proposer_index = spec.get_beacon_proposer_index(state)
+        else:
+            # use a stub state to get the proposer index of a future slot
+            stub_state = deepcopy(state)
+            spec.process_slots(stub_state, block.slot)
+            proposer_index = spec.get_beacon_proposer_index(stub_state)
+
+    privkey = privkeys[proposer_index]
+
+    block.body.randao_reveal = bls_sign(
+        privkey=privkey,
+        message_hash=hash_tree_root(spec.slot_to_epoch(block.slot)),
+        domain=spec.get_domain(state, spec.DOMAIN_RANDAO, message_epoch=spec.slot_to_epoch(block.slot)),
+    )
+    block.signature = bls_sign(
+        message_hash=signing_root(block),
+        privkey=privkey,
+        domain=spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER, spec.slot_to_epoch(block.slot)),
+    )
+
+
+def apply_empty_block(spec, state):
+    """Transition via an empty block on the current slot; returns the block."""
+    block = build_empty_block(spec, state, signed=True)
+    spec.state_transition(state, block)
+    return block
+
+
+def build_empty_block(spec, state, slot=None, signed=False):
+    if slot is None:
+        slot = state.slot
+    empty_block = spec.BeaconBlock()
+    empty_block.slot = slot
+    empty_block.body.eth1_data.deposit_count = state.deposit_index
+    previous_block_header = deepcopy(state.latest_block_header)
+    if previous_block_header.state_root == spec.ZERO_HASH:
+        previous_block_header.state_root = hash_tree_root(state)
+    empty_block.parent_root = signing_root(previous_block_header)
+
+    if signed:
+        sign_block(spec, state, empty_block)
+
+    return empty_block
+
+
+def build_empty_block_for_next_slot(spec, state, signed=False):
+    return build_empty_block(spec, state, state.slot + 1, signed=signed)

@@ -1,0 +1,11 @@
+"""Block-header signing helper (reference test/helpers/block_header.py)."""
+from ...crypto.bls import bls_sign
+from ...utils.ssz.impl import signing_root
+
+
+def sign_block_header(spec, state, header, privkey):
+    header.signature = bls_sign(
+        message_hash=signing_root(header),
+        privkey=privkey,
+        domain=spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER),
+    )

@@ -1,0 +1,65 @@
+"""Deposit factories with real Merkle branches (reference test/helpers/deposits.py)."""
+from __future__ import annotations
+
+from ...crypto.bls import bls_sign
+from ...utils.merkle import calc_merkle_tree_from_leaves, get_merkle_proof
+from ...utils.ssz.impl import signing_root, hash_tree_root
+from .keys import privkeys, pubkeys
+
+
+def build_deposit_data(spec, state, pubkey, privkey, amount, withdrawal_credentials, signed=False):
+    deposit_data = spec.DepositData(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        amount=amount,
+    )
+    if signed:
+        sign_deposit_data(spec, state, deposit_data, privkey)
+    return deposit_data
+
+
+def sign_deposit_data(spec, state, deposit_data, privkey):
+    deposit_data.signature = bls_sign(
+        message_hash=signing_root(deposit_data),
+        privkey=privkey,
+        domain=spec.bls_domain(spec.DOMAIN_DEPOSIT),
+    )
+
+
+def build_deposit(spec, state, deposit_data_leaves, pubkey, privkey, amount,
+                  withdrawal_credentials, signed):
+    deposit_data = build_deposit_data(spec, state, pubkey, privkey, amount,
+                                      withdrawal_credentials, signed)
+
+    item = hash_tree_root(deposit_data)
+    index = len(deposit_data_leaves)
+    deposit_data_leaves.append(item)
+    tree = calc_merkle_tree_from_leaves(deposit_data_leaves, spec.DEPOSIT_CONTRACT_TREE_DEPTH)
+    root = tree[-1][0]
+    proof = get_merkle_proof(tree, item_index=index)
+    assert spec.verify_merkle_branch(item, proof, spec.DEPOSIT_CONTRACT_TREE_DEPTH, index, root)
+
+    deposit = spec.Deposit(proof=list(proof), data=deposit_data)
+    return deposit, root, deposit_data_leaves
+
+
+def prepare_state_and_deposit(spec, state, validator_index, amount,
+                              withdrawal_credentials=None, signed=False):
+    """Plant a deposit root in the state and return a matching deposit."""
+    pre_validator_count = len(state.validator_registry)
+    deposit_data_leaves = [spec.ZERO_HASH] * pre_validator_count
+
+    pubkey = pubkeys[validator_index]
+    privkey = privkeys[validator_index]
+
+    # insecurely reuse pubkey hash as withdrawal credentials if none provided
+    if withdrawal_credentials is None:
+        withdrawal_credentials = spec.int_to_bytes(spec.BLS_WITHDRAWAL_PREFIX, length=1) \
+            + spec.hash(pubkey)[1:]
+
+    deposit, root, deposit_data_leaves = build_deposit(
+        spec, state, deposit_data_leaves, pubkey, privkey, amount, withdrawal_credentials, signed)
+
+    state.latest_eth1_data.deposit_root = root
+    state.latest_eth1_data.deposit_count = len(deposit_data_leaves)
+    return deposit

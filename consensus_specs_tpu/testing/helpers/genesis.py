@@ -1,0 +1,52 @@
+"""Fast mock genesis: hack validators in directly instead of processing
+deposits (reference test/helpers/genesis.py:20-47)."""
+from __future__ import annotations
+
+from ...utils.ssz.impl import hash_tree_root  # noqa: F401  (re-exported for tests)
+from .keys import pubkeys
+
+
+def build_mock_validator(spec, i: int, balance: int):
+    pubkey = pubkeys[i]
+    # insecurely reuse pubkey hash as withdrawal credentials
+    withdrawal_credentials = spec.int_to_bytes(spec.BLS_WITHDRAWAL_PREFIX, length=1) + spec.hash(pubkey)[1:]
+    return spec.Validator(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        activation_eligibility_epoch=spec.FAR_FUTURE_EPOCH,
+        activation_epoch=spec.FAR_FUTURE_EPOCH,
+        exit_epoch=spec.FAR_FUTURE_EPOCH,
+        withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+        effective_balance=min(balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT, spec.MAX_EFFECTIVE_BALANCE),
+    )
+
+
+def create_genesis_state(spec, num_validators: int):
+    deposit_root = b"\x42" * 32
+
+    state = spec.BeaconState(
+        genesis_time=0,
+        deposit_index=num_validators,
+        latest_eth1_data=spec.Eth1Data(
+            deposit_root=deposit_root,
+            deposit_count=num_validators,
+            block_hash=spec.ZERO_HASH,
+        ),
+    )
+
+    state.balances = [spec.MAX_EFFECTIVE_BALANCE] * num_validators
+    state.validator_registry = [build_mock_validator(spec, i, state.balances[i]) for i in range(num_validators)]
+
+    # Process genesis activations
+    for validator in state.validator_registry:
+        if validator.effective_balance >= spec.MAX_EFFECTIVE_BALANCE:
+            validator.activation_eligibility_epoch = spec.GENESIS_EPOCH
+            validator.activation_epoch = spec.GENESIS_EPOCH
+
+    from ...utils.ssz.typing import List as SSZList, uint64
+    genesis_active_index_root = hash_tree_root(
+        spec.get_active_validator_indices(state, spec.GENESIS_EPOCH), SSZList[uint64])
+    for index in range(spec.LATEST_ACTIVE_INDEX_ROOTS_LENGTH):
+        state.latest_active_index_roots[index] = genesis_active_index_root
+
+    return state

@@ -1,0 +1,30 @@
+"""State progression helpers (reference test/helpers/state.py)."""
+from __future__ import annotations
+
+from ...utils.ssz.impl import hash_tree_root
+from .block import sign_block
+
+
+def get_balance(state, index: int) -> int:
+    return state.balances[index]
+
+
+def next_slot(spec, state) -> None:
+    spec.process_slots(state, state.slot + 1)
+
+
+def next_epoch(spec, state) -> None:
+    slot = state.slot + spec.SLOTS_PER_EPOCH - (state.slot % spec.SLOTS_PER_EPOCH)
+    spec.process_slots(state, slot)
+
+
+def get_state_root(spec, state, slot) -> bytes:
+    assert slot < state.slot <= slot + spec.SLOTS_PER_HISTORICAL_ROOT
+    return state.latest_state_roots[slot % spec.SLOTS_PER_HISTORICAL_ROOT]
+
+
+def state_transition_and_sign_block(spec, state, block) -> None:
+    """Apply the block, then seal it with the post-state root + signature."""
+    spec.state_transition(state, block)
+    block.state_root = hash_tree_root(state)
+    sign_block(spec, state, block)

@@ -1,0 +1,1 @@
+"""The dual-use spec test corpus (pytest suite AND conformance-vector source)."""

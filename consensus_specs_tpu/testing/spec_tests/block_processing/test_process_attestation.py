@@ -1,0 +1,217 @@
+"""process_attestation cases (coverage parity:
+/root/reference .../test/phase_0/block_processing/test_process_attestation.py)."""
+from copy import deepcopy
+
+from ...context import always_bls, spec_state_test, with_all_phases, with_phase0
+from ...helpers.attestations import get_valid_attestation, sign_attestation
+from ...helpers.block import apply_empty_block
+from ...helpers.state import next_epoch, next_slot
+from ...runners import run_attestation_processing
+
+
+def _ready_attestation(spec, state, signed=True):
+    """A valid attestation with the state advanced past the inclusion delay."""
+    attestation = get_valid_attestation(spec, state, signed=signed)
+    state.slot += spec.MIN_ATTESTATION_INCLUSION_DELAY
+    return attestation
+
+
+@with_all_phases
+@spec_state_test
+def test_success(spec, state):
+    attestation = _ready_attestation(spec, state)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_success_previous_epoch(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_epoch(spec, state)
+    apply_empty_block(spec, state)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_success_since_max_epochs_per_crosslink(spec, state):
+    for _ in range(spec.MAX_EPOCHS_PER_CROSSLINK + 2):
+        next_epoch(spec, state)
+    apply_empty_block(spec, state)
+
+    attestation = get_valid_attestation(spec, state, signed=True)
+    data = attestation.data
+    assert data.crosslink.end_epoch - data.crosslink.start_epoch == spec.MAX_EPOCHS_PER_CROSSLINK
+
+    for _ in range(spec.MIN_ATTESTATION_INCLUSION_DELAY):
+        next_slot(spec, state)
+    apply_empty_block(spec, state)
+
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_invalid_attestation_signature(spec, state):
+    attestation = _ready_attestation(spec, state, signed=False)
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_before_inclusion_delay(spec, state):
+    # state.slot stays put: inclusion delay not yet satisfied
+    attestation = get_valid_attestation(spec, state, signed=True)
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_after_epoch_slots(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # advance past the latest legal inclusion slot
+    spec.process_slots(state, state.slot + spec.SLOTS_PER_EPOCH + 1)
+    apply_empty_block(spec, state)
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_old_source_epoch(spec, state):
+    state.slot = spec.SLOTS_PER_EPOCH * 5
+    state.finalized_epoch = 2
+    state.previous_justified_epoch = 3
+    state.current_justified_epoch = 4
+    attestation = get_valid_attestation(spec, state, slot=(spec.SLOTS_PER_EPOCH * 3) + 1)
+    assert attestation.data.source_epoch == state.previous_justified_epoch
+
+    attestation.data.source_epoch -= 1  # older than the oldest known source
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_wrong_shard(spec, state):
+    attestation = _ready_attestation(spec, state, signed=False)
+    attestation.data.crosslink.shard += 1
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_source_epoch(spec, state):
+    attestation = _ready_attestation(spec, state, signed=False)
+    attestation.data.source_epoch += 1
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_source_root_is_target_root(spec, state):
+    attestation = _ready_attestation(spec, state, signed=False)
+    attestation.data.source_root = attestation.data.target_root
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_current_source_root(spec, state):
+    state.slot = spec.SLOTS_PER_EPOCH * 5
+    state.finalized_epoch = 2
+    state.previous_justified_epoch = 3
+    state.previous_justified_root = b"\x01" * 32
+    state.current_justified_epoch = 4
+    state.current_justified_root = b"\xff" * 32
+
+    attestation = get_valid_attestation(spec, state, slot=(spec.SLOTS_PER_EPOCH * 3) + 1)
+    state.slot += spec.MIN_ATTESTATION_INCLUSION_DELAY
+    assert attestation.data.source_root == state.previous_justified_root
+
+    # must be the previous justified root, not the current one
+    attestation.data.source_root = state.current_justified_root
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_bad_source_root(spec, state):
+    attestation = _ready_attestation(spec, state, signed=False)
+    attestation.data.source_root = b"\x42" * 32
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_phase0
+@spec_state_test
+def test_non_zero_crosslink_data_root(spec, state):
+    attestation = _ready_attestation(spec, state, signed=False)
+    attestation.data.crosslink.data_root = b"\x42" * 32
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+def _next_epoch_attestation(spec, state):
+    next_epoch(spec, state)
+    apply_empty_block(spec, state)
+    attestation = get_valid_attestation(spec, state, signed=True)
+    for _ in range(spec.MIN_ATTESTATION_INCLUSION_DELAY):
+        next_slot(spec, state)
+    apply_empty_block(spec, state)
+    return attestation
+
+
+@with_all_phases
+@spec_state_test
+def test_bad_parent_crosslink(spec, state):
+    attestation = _next_epoch_attestation(spec, state)
+    attestation.data.crosslink.parent_root = b"\x27" * 32
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_bad_crosslink_start_epoch(spec, state):
+    attestation = _next_epoch_attestation(spec, state)
+    attestation.data.crosslink.start_epoch += 1
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_bad_crosslink_end_epoch(spec, state):
+    attestation = _next_epoch_attestation(spec, state)
+    attestation.data.crosslink.end_epoch += 1
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_inconsistent_bitfields(spec, state):
+    attestation = _ready_attestation(spec, state, signed=False)
+    attestation.custody_bitfield = deepcopy(attestation.aggregation_bitfield) + b"\x00"
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_phase0
+@spec_state_test
+def test_non_empty_custody_bitfield(spec, state):
+    attestation = _ready_attestation(spec, state, signed=False)
+    attestation.custody_bitfield = deepcopy(attestation.aggregation_bitfield)
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_aggregation_bitfield(spec, state):
+    attestation = _ready_attestation(spec, state, signed=False)
+    attestation.aggregation_bitfield = b"\x00" * len(attestation.aggregation_bitfield)
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation)

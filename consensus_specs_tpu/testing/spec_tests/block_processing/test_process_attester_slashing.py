@@ -1,0 +1,96 @@
+"""process_attester_slashing cases (coverage parity:
+/root/reference .../block_processing/test_process_attester_slashing.py)."""
+from ...context import always_bls, spec_state_test, with_all_phases
+from ...helpers.attestations import sign_indexed_attestation
+from ...helpers.attester_slashings import get_valid_attester_slashing
+from ...helpers.block import apply_empty_block
+from ...helpers.state import next_epoch
+from ...runners import run_attester_slashing_processing
+
+
+@with_all_phases
+@spec_state_test
+def test_success_double(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing)
+
+
+@with_all_phases
+@spec_state_test
+def test_success_surround(spec, state):
+    next_epoch(spec, state)
+    apply_empty_block(spec, state)
+
+    state.current_justified_epoch += 1
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=True)
+
+    # attestation_1 surrounds attestation_2
+    attester_slashing.attestation_1.data.source_epoch = \
+        attester_slashing.attestation_2.data.source_epoch - 1
+    attester_slashing.attestation_1.data.target_epoch = \
+        attester_slashing.attestation_2.data.target_epoch + 1
+    sign_indexed_attestation(spec, state, attester_slashing.attestation_1)
+
+    yield from run_attester_slashing_processing(spec, state, attester_slashing)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_invalid_sig_1(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=True)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_invalid_sig_2(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=False)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_invalid_sig_1_and_2(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=False)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_same_data(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=True)
+    attester_slashing.attestation_1.data = attester_slashing.attestation_2.data
+    sign_indexed_attestation(spec, state, attester_slashing.attestation_1)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_no_double_or_surround(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=True)
+    attester_slashing.attestation_1.data.target_epoch += 1  # no longer slashable
+    sign_indexed_attestation(spec, state, attester_slashing.attestation_1)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_participants_already_slashed(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    attestation_1 = attester_slashing.attestation_1
+    for index in list(attestation_1.custody_bit_0_indices) + list(attestation_1.custody_bit_1_indices):
+        state.validator_registry[index].slashed = True
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_custody_bit_0_and_1(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=True)
+    attester_slashing.attestation_1.custody_bit_1_indices = \
+        attester_slashing.attestation_1.custody_bit_0_indices
+    sign_indexed_attestation(spec, state, attester_slashing.attestation_1)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)

@@ -1,0 +1,54 @@
+"""process_block_header cases (coverage parity:
+/root/reference .../block_processing/test_process_block_header.py)."""
+from copy import deepcopy
+
+from ...context import always_bls, spec_state_test, with_all_phases
+from ...helpers.block import build_empty_block_for_next_slot, sign_block
+from ...helpers.state import next_slot
+from ...runners import run_block_header_processing
+
+
+@with_all_phases
+@spec_state_test
+def test_success_block_header(spec, state):
+    block = build_empty_block_for_next_slot(spec, state, signed=True)
+    yield from run_block_header_processing(spec, state, block)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_invalid_sig_block_header(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)  # unsigned
+    yield from run_block_header_processing(spec, state, block, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_slot_block_header(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.slot = state.slot + 2  # not the state's next slot
+    sign_block(spec, state, block)
+    yield from run_block_header_processing(spec, state, block, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_parent_root(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.parent_root = b"\x12" * 32
+    sign_block(spec, state, block)
+    yield from run_block_header_processing(spec, state, block, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashed(spec, state):
+    # find the next slot's proposer on a throwaway copy, slash them
+    stub_state = deepcopy(state)
+    next_slot(spec, stub_state)
+    proposer_index = spec.get_beacon_proposer_index(stub_state)
+    state.validator_registry[proposer_index].slashed = True
+
+    block = build_empty_block_for_next_slot(spec, state, signed=True)
+    yield from run_block_header_processing(spec, state, block, valid=False)

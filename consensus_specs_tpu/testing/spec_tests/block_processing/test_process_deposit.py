@@ -1,0 +1,105 @@
+"""process_deposit cases (coverage parity:
+/root/reference .../block_processing/test_process_deposit.py)."""
+from ...context import always_bls, spec_state_test, with_all_phases
+from ...helpers.deposits import build_deposit, prepare_state_and_deposit, sign_deposit_data
+from ...helpers.keys import privkeys, pubkeys
+from ...runners import run_deposit_processing
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit(spec, state):
+    validator_index = len(state.validator_registry)  # fresh index: appends to registry
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_invalid_sig_new_deposit(spec, state):
+    # invalid proof-of-possession: deposit is skipped, block stays valid
+    validator_index = len(state.validator_registry)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount)
+    yield from run_deposit_processing(spec, state, deposit, validator_index, valid=True, effective=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_success_top_up(spec, state):
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_invalid_sig_top_up(spec, state):
+    # top-ups don't check the signature at all
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount)
+    yield from run_deposit_processing(spec, state, deposit, validator_index, valid=True, effective=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_withdrawal_credentials_top_up(spec, state):
+    # inconsistent withdrawal credentials are fine for top-ups
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    withdrawal_credentials = spec.int_to_bytes(spec.BLS_WITHDRAWAL_PREFIX, length=1) \
+        + spec.hash(b"junk")[1:]
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount,
+                                        withdrawal_credentials=withdrawal_credentials)
+    yield from run_deposit_processing(spec, state, deposit, validator_index, valid=True, effective=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_wrong_deposit_index(spec, state):
+    # out-of-order processing: the branch no longer verifies at state.deposit_index
+    validator_index = len(state.validator_registry)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount)
+    state.deposit_index += 1
+    sign_deposit_data(spec, state, deposit.data, privkeys[validator_index])
+    yield from run_deposit_processing(spec, state, deposit, validator_index, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_wrong_deposit_for_deposit_count(spec, state):
+    deposit_data_leaves = [spec.ZERO_HASH] * len(state.validator_registry)
+
+    # two deposits; state carries deposit_2's root but deposit_1's count
+    index_1 = len(deposit_data_leaves)
+    _, _, deposit_data_leaves = build_deposit(
+        spec, state, deposit_data_leaves, pubkeys[index_1], privkeys[index_1],
+        spec.MAX_EFFECTIVE_BALANCE, withdrawal_credentials=b"\x00" * 32, signed=True)
+    deposit_count_1 = len(deposit_data_leaves)
+
+    index_2 = len(deposit_data_leaves)
+    deposit_2, root_2, deposit_data_leaves = build_deposit(
+        spec, state, deposit_data_leaves, pubkeys[index_2], privkeys[index_2],
+        spec.MAX_EFFECTIVE_BALANCE, withdrawal_credentials=b"\x00" * 32, signed=True)
+
+    state.latest_eth1_data.deposit_root = root_2
+    state.latest_eth1_data.deposit_count = deposit_count_1
+
+    yield from run_deposit_processing(spec, state, deposit_2, index_2, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_bad_merkle_proof(spec, state):
+    validator_index = len(state.validator_registry)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount)
+    deposit.proof[-1] = spec.ZERO_HASH  # corrupt the branch
+    sign_deposit_data(spec, state, deposit.data, privkeys[validator_index])
+    yield from run_deposit_processing(spec, state, deposit, validator_index, valid=False)

@@ -1,0 +1,92 @@
+"""process_proposer_slashing cases (coverage parity:
+/root/reference .../block_processing/test_process_proposer_slashing.py)."""
+from ...context import always_bls, spec_state_test, with_all_phases
+from ...helpers.block_header import sign_block_header
+from ...helpers.keys import privkeys
+from ...helpers.proposer_slashings import get_valid_proposer_slashing
+from ...runners import run_proposer_slashing_processing
+
+
+@with_all_phases
+@spec_state_test
+def test_success(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_invalid_sig_1(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=False, signed_2=True)
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_invalid_sig_2(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=False)
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_invalid_sig_1_and_2(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=False, signed_2=False)
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_index(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    proposer_slashing.proposer_index = len(state.validator_registry)  # out of range
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_epochs_are_different(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=False)
+    proposer_slashing.header_2.slot += spec.SLOTS_PER_EPOCH
+    sign_block_header(spec, state, proposer_slashing.header_2,
+                      privkeys[proposer_slashing.proposer_index])
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_headers_are_same(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=False)
+    proposer_slashing.header_2 = proposer_slashing.header_1
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_is_not_activated(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    state.validator_registry[proposer_slashing.proposer_index].activation_epoch = \
+        spec.get_current_epoch(state) + 1
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_is_slashed(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    state.validator_registry[proposer_slashing.proposer_index].slashed = True
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_is_withdrawn(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    # move forward an epoch so a past withdrawable_epoch is representable
+    state.slot += spec.SLOTS_PER_EPOCH
+    proposer_index = proposer_slashing.proposer_index
+    state.validator_registry[proposer_index].withdrawable_epoch = spec.get_current_epoch(state) - 1
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)

@@ -1,0 +1,131 @@
+"""process_transfer cases (coverage parity:
+/root/reference .../block_processing/test_process_transfer.py)."""
+from ...context import always_bls, spec_state_test, with_all_phases
+from ...helpers.block import apply_empty_block
+from ...helpers.state import next_epoch
+from ...helpers.transfers import get_valid_transfer
+from ...runners import run_transfer_processing
+
+
+def _unlock_sender(spec, state, transfer, how="eligibility"):
+    """Make the sender transfer-eligible the way the reference tests do."""
+    validator = state.validator_registry[transfer.sender]
+    if how == "eligibility":
+        validator.activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    else:
+        validator.activation_epoch = spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_success_non_activated(spec, state):
+    transfer = get_valid_transfer(spec, state, signed=True)
+    _unlock_sender(spec, state, transfer)
+    yield from run_transfer_processing(spec, state, transfer)
+
+
+@with_all_phases
+@spec_state_test
+def test_success_withdrawable(spec, state):
+    next_epoch(spec, state)
+    apply_empty_block(spec, state)
+    transfer = get_valid_transfer(spec, state, signed=True)
+    state.validator_registry[transfer.sender].withdrawable_epoch = spec.get_current_epoch(state) - 1
+    yield from run_transfer_processing(spec, state, transfer)
+
+
+@with_all_phases
+@spec_state_test
+def test_success_active_above_max_effective(spec, state):
+    sender_index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    state.balances[sender_index] = spec.MAX_EFFECTIVE_BALANCE + 1
+    transfer = get_valid_transfer(spec, state, sender_index=sender_index, amount=1, fee=0, signed=True)
+    yield from run_transfer_processing(spec, state, transfer)
+
+
+@with_all_phases
+@spec_state_test
+def test_success_active_above_max_effective_fee(spec, state):
+    sender_index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    state.balances[sender_index] = spec.MAX_EFFECTIVE_BALANCE + 1
+    transfer = get_valid_transfer(spec, state, sender_index=sender_index, amount=0, fee=1, signed=True)
+    yield from run_transfer_processing(spec, state, transfer)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_invalid_signature(spec, state):
+    transfer = get_valid_transfer(spec, state)  # unsigned
+    _unlock_sender(spec, state, transfer)
+    yield from run_transfer_processing(spec, state, transfer, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_active_but_transfer_past_effective_balance(spec, state):
+    sender_index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    amount = spec.MAX_EFFECTIVE_BALANCE // 32
+    state.balances[sender_index] = spec.MAX_EFFECTIVE_BALANCE
+    transfer = get_valid_transfer(spec, state, sender_index=sender_index, amount=amount, fee=0, signed=True)
+    yield from run_transfer_processing(spec, state, transfer, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_slot(spec, state):
+    transfer = get_valid_transfer(spec, state, slot=state.slot + 1, signed=True)
+    _unlock_sender(spec, state, transfer, how="activation")
+    yield from run_transfer_processing(spec, state, transfer, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_insufficient_balance_for_fee(spec, state):
+    sender_index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    state.balances[sender_index] = spec.MAX_EFFECTIVE_BALANCE
+    transfer = get_valid_transfer(spec, state, sender_index=sender_index, amount=0, fee=1, signed=True)
+    _unlock_sender(spec, state, transfer, how="activation")
+    yield from run_transfer_processing(spec, state, transfer, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_insufficient_balance(spec, state):
+    sender_index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    state.balances[sender_index] = spec.MAX_EFFECTIVE_BALANCE
+    transfer = get_valid_transfer(spec, state, sender_index=sender_index, amount=1, fee=0, signed=True)
+    _unlock_sender(spec, state, transfer, how="activation")
+    yield from run_transfer_processing(spec, state, transfer, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_no_dust_sender(spec, state):
+    sender_index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    balance = state.balances[sender_index]
+    transfer = get_valid_transfer(
+        spec, state, sender_index=sender_index,
+        amount=balance - spec.MIN_DEPOSIT_AMOUNT + 1, fee=0, signed=True)
+    _unlock_sender(spec, state, transfer, how="activation")
+    yield from run_transfer_processing(spec, state, transfer, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_no_dust_recipient(spec, state):
+    sender_index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    state.balances[sender_index] = spec.MAX_EFFECTIVE_BALANCE + 1
+    transfer = get_valid_transfer(spec, state, sender_index=sender_index, amount=1, fee=0, signed=True)
+    state.balances[transfer.recipient] = 0
+    _unlock_sender(spec, state, transfer, how="activation")
+    yield from run_transfer_processing(spec, state, transfer, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_pubkey(spec, state):
+    transfer = get_valid_transfer(spec, state, signed=True)
+    state.validator_registry[transfer.sender].withdrawal_credentials = spec.ZERO_HASH
+    _unlock_sender(spec, state, transfer, how="activation")
+    yield from run_transfer_processing(spec, state, transfer, False)

@@ -1,0 +1,114 @@
+"""process_voluntary_exit cases (coverage parity:
+/root/reference .../block_processing/test_process_voluntary_exit.py)."""
+from ...context import always_bls, spec_state_test, with_all_phases
+from ...helpers.keys import pubkey_to_privkey
+from ...helpers.voluntary_exits import build_voluntary_exit, sign_voluntary_exit
+from ...runners import run_voluntary_exit_processing
+
+
+def _exitable_state(spec, state):
+    """Advance past PERSISTENT_COMMITTEE_PERIOD so exits are permitted."""
+    state.slot += spec.PERSISTENT_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+
+
+def _exit_for(spec, state, validator_index, signed=True):
+    current_epoch = spec.get_current_epoch(state)
+    privkey = pubkey_to_privkey(state.validator_registry[validator_index].pubkey)
+    return build_voluntary_exit(spec, state, current_epoch, validator_index, privkey, signed=signed)
+
+
+@with_all_phases
+@spec_state_test
+def test_success(spec, state):
+    _exitable_state(spec, state)
+    validator_index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[0]
+    voluntary_exit = _exit_for(spec, state, validator_index)
+    yield from run_voluntary_exit_processing(spec, state, voluntary_exit)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_invalid_signature(spec, state):
+    _exitable_state(spec, state)
+    validator_index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[0]
+    voluntary_exit = _exit_for(spec, state, validator_index, signed=False)
+    yield from run_voluntary_exit_processing(spec, state, voluntary_exit, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_success_exit_queue(spec, state):
+    _exitable_state(spec, state)
+    current_epoch = spec.get_current_epoch(state)
+
+    # fill the queue up to the churn limit, from the same pre-state
+    initial_indices = spec.get_active_validator_indices(state, current_epoch)[:spec.get_churn_limit(state)]
+    exit_queue = [_exit_for(spec, state, index) for index in initial_indices]
+    for voluntary_exit in exit_queue:
+        for _ in run_voluntary_exit_processing(spec, state, voluntary_exit):
+            continue
+
+    # one more exit: must land in the next epoch
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[-1]
+    voluntary_exit = _exit_for(spec, state, validator_index)
+    yield from run_voluntary_exit_processing(spec, state, voluntary_exit)
+
+    assert (state.validator_registry[validator_index].exit_epoch
+            == state.validator_registry[initial_indices[0]].exit_epoch + 1)
+
+
+@with_all_phases
+@spec_state_test
+def test_validator_exit_in_future(spec, state):
+    _exitable_state(spec, state)
+    validator_index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[0]
+    voluntary_exit = _exit_for(spec, state, validator_index, signed=False)
+    voluntary_exit.epoch += 1
+    privkey = pubkey_to_privkey(state.validator_registry[validator_index].pubkey)
+    sign_voluntary_exit(spec, state, voluntary_exit, privkey)
+    yield from run_voluntary_exit_processing(spec, state, voluntary_exit, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_validator_invalid_validator_index(spec, state):
+    _exitable_state(spec, state)
+    validator_index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[0]
+    voluntary_exit = _exit_for(spec, state, validator_index, signed=False)
+    voluntary_exit.validator_index = len(state.validator_registry)
+    privkey = pubkey_to_privkey(state.validator_registry[validator_index].pubkey)
+    sign_voluntary_exit(spec, state, voluntary_exit, privkey)
+    yield from run_voluntary_exit_processing(spec, state, voluntary_exit, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_validator_not_active(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[0]
+    state.validator_registry[validator_index].activation_epoch = spec.FAR_FUTURE_EPOCH
+    voluntary_exit = _exit_for(spec, state, validator_index)
+    yield from run_voluntary_exit_processing(spec, state, voluntary_exit, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_validator_already_exited(spec, state):
+    _exitable_state(spec, state)
+    current_epoch = spec.get_current_epoch(state)
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[0]
+    state.validator_registry[validator_index].exit_epoch = current_epoch + 2
+    voluntary_exit = _exit_for(spec, state, validator_index)
+    yield from run_voluntary_exit_processing(spec, state, voluntary_exit, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_validator_not_active_long_enough(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[0]
+    voluntary_exit = _exit_for(spec, state, validator_index)
+    assert (current_epoch - state.validator_registry[validator_index].activation_epoch
+            < spec.PERSISTENT_COMMITTEE_PERIOD)
+    yield from run_voluntary_exit_processing(spec, state, voluntary_exit, False)

@@ -1,0 +1,64 @@
+"""process_registry_updates cases (coverage parity:
+/root/reference .../epoch_processing/test_process_registry_updates.py)."""
+from ...context import spec_state_test, with_all_phases
+from ...helpers.block import build_empty_block_for_next_slot, sign_block
+from ...helpers.state import next_epoch, state_transition_and_sign_block
+
+
+def run_process_registry_updates(spec, state):
+    slot = state.slot + (spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH) - 1
+    block = build_empty_block_for_next_slot(spec, state)
+    block.slot = slot
+    sign_block(spec, state, block)
+    state_transition_and_sign_block(spec, state, block)
+
+    spec.process_slot(state)
+    spec.process_justification_and_finalization(state)
+    spec.process_crosslinks(state)
+    spec.process_rewards_and_penalties(state)
+
+    yield "pre", state
+    spec.process_registry_updates(state)
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_activation(spec, state):
+    index = 0
+    # mock a fresh deposit on an existing slot
+    validator = state.validator_registry[index]
+    validator.activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    validator.activation_epoch = spec.FAR_FUTURE_EPOCH
+    validator.effective_balance = spec.MAX_EFFECTIVE_BALANCE
+    assert not spec.is_active_validator(validator, spec.get_current_epoch(state))
+
+    for _ in range(spec.ACTIVATION_EXIT_DELAY + 1):
+        next_epoch(spec, state)
+
+    yield from run_process_registry_updates(spec, state)
+
+    validator = state.validator_registry[index]
+    assert validator.activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
+    assert validator.activation_epoch != spec.FAR_FUTURE_EPOCH
+    assert spec.is_active_validator(validator, spec.get_current_epoch(state))
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection(spec, state):
+    index = 0
+    assert spec.is_active_validator(state.validator_registry[index], spec.get_current_epoch(state))
+    assert state.validator_registry[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+    # drop effective balance to the ejection threshold
+    state.validator_registry[index].effective_balance = spec.EJECTION_BALANCE
+
+    for _ in range(spec.ACTIVATION_EXIT_DELAY + 1):
+        next_epoch(spec, state)
+
+    yield from run_process_registry_updates(spec, state)
+
+    assert state.validator_registry[index].exit_epoch != spec.FAR_FUTURE_EPOCH
+    assert not spec.is_active_validator(
+        state.validator_registry[index], spec.get_current_epoch(state))

@@ -1,0 +1,287 @@
+"""Multi-op block sanity cases (coverage parity:
+/root/reference .../test/sanity/test_blocks.py)."""
+from copy import deepcopy
+
+from ....crypto.bls import bls_sign
+from ....utils.ssz.typing import List as SSZList
+from ....utils.ssz.impl import hash_tree_root, signing_root
+from ...context import spec_state_test, with_all_phases
+from ...helpers.attestations import get_valid_attestation
+from ...helpers.attester_slashings import get_valid_attester_slashing
+from ...helpers.block import build_empty_block_for_next_slot, sign_block
+from ...helpers.deposits import prepare_state_and_deposit
+from ...helpers.keys import privkeys, pubkeys
+from ...helpers.proposer_slashings import get_valid_proposer_slashing
+from ...helpers.state import get_balance, state_transition_and_sign_block
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_block_transition(spec, state):
+    pre_slot = state.slot
+    pre_eth1_votes = len(state.eth1_data_votes)
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state, signed=True)
+    state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [block], SSZList[spec.BeaconBlock]
+    yield "post", state
+
+    assert len(state.eth1_data_votes) == pre_eth1_votes + 1
+    assert spec.get_block_root_at_slot(state, pre_slot) == block.parent_root
+    assert spec.get_randao_mix(state, spec.get_current_epoch(state)) != spec.ZERO_HASH
+
+
+@with_all_phases
+@spec_state_test
+def test_skipped_slots(spec, state):
+    pre_slot = state.slot
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.slot += 3
+    sign_block(spec, state, block)
+    state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [block], SSZList[spec.BeaconBlock]
+    yield "post", state
+
+    assert state.slot == block.slot
+    assert spec.get_randao_mix(state, spec.get_current_epoch(state)) != spec.ZERO_HASH
+    for slot in range(pre_slot, state.slot):
+        assert spec.get_block_root_at_slot(state, slot) == block.parent_root
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch_transition(spec, state):
+    pre_slot = state.slot
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.slot += spec.SLOTS_PER_EPOCH
+    sign_block(spec, state, block)
+    state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [block], SSZList[spec.BeaconBlock]
+    yield "post", state
+
+    assert state.slot == block.slot
+    for slot in range(pre_slot, state.slot):
+        assert spec.get_block_root_at_slot(state, slot) == block.parent_root
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing(spec, state):
+    pre_state = deepcopy(state)
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    validator_index = proposer_slashing.proposer_index
+    assert not state.validator_registry[validator_index].slashed
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings.append(proposer_slashing)
+    sign_block(spec, state, block)
+    state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [block], SSZList[spec.BeaconBlock]
+    yield "post", state
+
+    slashed_validator = state.validator_registry[validator_index]
+    assert slashed_validator.slashed
+    assert slashed_validator.exit_epoch < spec.FAR_FUTURE_EPOCH
+    assert slashed_validator.withdrawable_epoch < spec.FAR_FUTURE_EPOCH
+    assert get_balance(state, validator_index) < get_balance(pre_state, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing(spec, state):
+    pre_state = deepcopy(state)
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    validator_index = (list(attester_slashing.attestation_1.custody_bit_0_indices)
+                       + list(attester_slashing.attestation_1.custody_bit_1_indices))[0]
+    assert not state.validator_registry[validator_index].slashed
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings.append(attester_slashing)
+    sign_block(spec, state, block)
+    state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [block], SSZList[spec.BeaconBlock]
+    yield "post", state
+
+    slashed_validator = state.validator_registry[validator_index]
+    assert slashed_validator.slashed
+    assert slashed_validator.exit_epoch < spec.FAR_FUTURE_EPOCH
+    assert slashed_validator.withdrawable_epoch < spec.FAR_FUTURE_EPOCH
+    assert get_balance(state, validator_index) < get_balance(pre_state, validator_index)
+    proposer_index = spec.get_beacon_proposer_index(state)
+    assert get_balance(state, proposer_index) > get_balance(pre_state, proposer_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_in_block(spec, state):
+    initial_registry_len = len(state.validator_registry)
+    validator_index = initial_registry_len
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.deposits.append(deposit)
+    sign_block(spec, state, block)
+    state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [block], SSZList[spec.BeaconBlock]
+    yield "post", state
+
+    assert len(state.validator_registry) == initial_registry_len + 1
+    assert len(state.balances) == initial_registry_len + 1
+    assert get_balance(state, validator_index) == spec.MAX_EFFECTIVE_BALANCE
+    assert state.validator_registry[validator_index].pubkey == pubkeys[validator_index]
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_top_up(spec, state):
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount)
+
+    initial_registry_len = len(state.validator_registry)
+    validator_pre_balance = get_balance(state, validator_index)
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.deposits.append(deposit)
+    sign_block(spec, state, block)
+    state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [block], SSZList[spec.BeaconBlock]
+    yield "post", state
+
+    assert len(state.validator_registry) == initial_registry_len
+    assert len(state.balances) == initial_registry_len
+    assert get_balance(state, validator_index) == validator_pre_balance + amount
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation(spec, state):
+    state.slot = spec.SLOTS_PER_EPOCH
+
+    yield "pre", state
+
+    attestation = get_valid_attestation(spec, state, signed=True)
+
+    # include via block at the inclusion-delay slot
+    pre_current_attestations_len = len(state.current_epoch_attestations)
+    attestation_block = build_empty_block_for_next_slot(spec, state)
+    attestation_block.slot += spec.MIN_ATTESTATION_INCLUSION_DELAY
+    attestation_block.body.attestations.append(attestation)
+    sign_block(spec, state, attestation_block)
+    state_transition_and_sign_block(spec, state, attestation_block)
+
+    assert len(state.current_epoch_attestations) == pre_current_attestations_len + 1
+
+    # the epoch transition rotates current -> previous
+    pre_current_attestations_root = hash_tree_root(state.current_epoch_attestations)
+
+    epoch_block = build_empty_block_for_next_slot(spec, state)
+    epoch_block.slot += spec.SLOTS_PER_EPOCH
+    sign_block(spec, state, epoch_block)
+    state_transition_and_sign_block(spec, state, epoch_block)
+
+    yield "blocks", [attestation_block, epoch_block], SSZList[spec.BeaconBlock]
+    yield "post", state
+
+    assert len(state.current_epoch_attestations) == 0
+    assert hash_tree_root(state.previous_epoch_attestations) == pre_current_attestations_root
+
+
+@with_all_phases
+@spec_state_test
+def test_voluntary_exit(spec, state):
+    validator_index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    state.slot += spec.PERSISTENT_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+
+    yield "pre", state
+
+    voluntary_exit = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state),
+        validator_index=validator_index,
+    )
+    voluntary_exit.signature = bls_sign(
+        message_hash=signing_root(voluntary_exit),
+        privkey=privkeys[validator_index],
+        domain=spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT),
+    )
+
+    initiate_exit_block = build_empty_block_for_next_slot(spec, state)
+    initiate_exit_block.body.voluntary_exits.append(voluntary_exit)
+    sign_block(spec, state, initiate_exit_block)
+    state_transition_and_sign_block(spec, state, initiate_exit_block)
+
+    assert state.validator_registry[validator_index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+    exit_block = build_empty_block_for_next_slot(spec, state)
+    exit_block.slot += spec.SLOTS_PER_EPOCH
+    sign_block(spec, state, exit_block)
+    state_transition_and_sign_block(spec, state, exit_block)
+
+    yield "blocks", [initiate_exit_block, exit_block], SSZList[spec.BeaconBlock]
+    yield "post", state
+
+    assert state.validator_registry[validator_index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_balance_driven_status_transitions(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[-1]
+    assert state.validator_registry[validator_index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+    # drop effective balance to the ejection threshold
+    state.validator_registry[validator_index].effective_balance = spec.EJECTION_BALANCE
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.slot += spec.SLOTS_PER_EPOCH
+    sign_block(spec, state, block)
+    state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [block], SSZList[spec.BeaconBlock]
+    yield "post", state
+
+    assert state.validator_registry[validator_index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_batch(spec, state):
+    state.slot += spec.SLOTS_PER_HISTORICAL_ROOT - (state.slot % spec.SLOTS_PER_HISTORICAL_ROOT) - 1
+    pre_historical_roots_len = len(state.historical_roots)
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state, signed=True)
+    state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [block], SSZList[spec.BeaconBlock]
+    yield "post", state
+
+    assert state.slot == block.slot
+    assert spec.get_current_epoch(state) % (spec.SLOTS_PER_HISTORICAL_ROOT // spec.SLOTS_PER_EPOCH) == 0
+    assert len(state.historical_roots) == pre_historical_roots_len + 1

@@ -1,0 +1,47 @@
+"""Slot-advance sanity cases (coverage parity:
+/root/reference .../test/sanity/test_slots.py)."""
+from ...context import spec_state_test, with_all_phases
+from ...helpers.state import get_state_root
+from ....utils.ssz.impl import hash_tree_root
+
+
+def _advance(spec, state, slots):
+    yield "pre", state
+    yield "slots", slots
+    spec.process_slots(state, state.slot + slots)
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_slots_1(spec, state):
+    pre_slot = state.slot
+    pre_root = hash_tree_root(state)
+    yield from _advance(spec, state, 1)
+    assert state.slot == pre_slot + 1
+    assert get_state_root(spec, state, pre_slot) == pre_root
+
+
+@with_all_phases
+@spec_state_test
+def test_slots_2(spec, state):
+    yield from _advance(spec, state, 2)
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch(spec, state):
+    yield from _advance(spec, state, spec.SLOTS_PER_EPOCH)
+
+
+@with_all_phases
+@spec_state_test
+def test_double_empty_epoch(spec, state):
+    yield from _advance(spec, state, spec.SLOTS_PER_EPOCH * 2)
+
+
+@with_all_phases
+@spec_state_test
+def test_over_epoch_boundary(spec, state):
+    spec.process_slots(state, state.slot + spec.SLOTS_PER_EPOCH // 2)
+    yield from _advance(spec, state, spec.SLOTS_PER_EPOCH)

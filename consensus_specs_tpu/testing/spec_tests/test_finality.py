@@ -1,0 +1,169 @@
+"""Casper FFG finality rules 1-4, driven epoch-by-epoch (coverage parity:
+/root/reference .../test/test_finality.py)."""
+from copy import deepcopy
+
+from ...utils.ssz.typing import List as SSZList
+from ..context import never_bls, spec_state_test, with_all_phases
+from ..helpers.attestations import get_valid_attestation
+from ..helpers.block import apply_empty_block, build_empty_block_for_next_slot
+from ..helpers.state import next_epoch, state_transition_and_sign_block
+
+
+def check_finality(spec, state, prev_state,
+                   current_justified_changed, previous_justified_changed, finalized_changed):
+    for changed, epoch_attr, root_attr in (
+        (current_justified_changed, "current_justified_epoch", "current_justified_root"),
+        (previous_justified_changed, "previous_justified_epoch", "previous_justified_root"),
+        (finalized_changed, "finalized_epoch", "finalized_root"),
+    ):
+        if changed:
+            assert getattr(state, epoch_attr) > getattr(prev_state, epoch_attr)
+            assert getattr(state, root_attr) != getattr(prev_state, root_attr)
+        else:
+            assert getattr(state, epoch_attr) == getattr(prev_state, epoch_attr)
+            assert getattr(state, root_attr) == getattr(prev_state, root_attr)
+
+
+def next_epoch_with_attestations(spec, state, fill_cur_epoch, fill_prev_epoch):
+    """Run one epoch of blocks carrying current- and/or previous-epoch
+    attestations; returns (pre_state, blocks, post_state)."""
+    post_state = deepcopy(state)
+    blocks = []
+    for _ in range(spec.SLOTS_PER_EPOCH):
+        block = build_empty_block_for_next_slot(spec, post_state)
+        if fill_cur_epoch:
+            slot_to_attest = post_state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1
+            if slot_to_attest >= spec.get_epoch_start_slot(spec.get_current_epoch(post_state)):
+                block.body.attestations.append(get_valid_attestation(spec, post_state, slot_to_attest))
+        if fill_prev_epoch:
+            slot_to_attest = post_state.slot - spec.SLOTS_PER_EPOCH + 1
+            block.body.attestations.append(get_valid_attestation(spec, post_state, slot_to_attest))
+        state_transition_and_sign_block(spec, post_state, block)
+        blocks.append(block)
+    return state, blocks, post_state
+
+
+def _skip_genesis_finality_epochs(spec, state):
+    next_epoch(spec, state)
+    apply_empty_block(spec, state)
+    next_epoch(spec, state)
+    apply_empty_block(spec, state)
+
+
+@with_all_phases
+@never_bls
+@spec_state_test
+def test_finality_rule_4(spec, state):
+    yield "pre", state
+
+    blocks = []
+    for epoch in range(4):
+        prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, True, False)
+        blocks += new_blocks
+
+        if epoch <= 1:
+            # no justification/finalization during the first two epochs
+            check_finality(spec, state, prev_state, False, False, False)
+        elif epoch == 2:
+            check_finality(spec, state, prev_state, True, False, False)
+        else:
+            # rule 4: 1st/2nd most recent justified, 1st via 2nd as source
+            check_finality(spec, state, prev_state, True, True, True)
+            assert state.finalized_epoch == prev_state.current_justified_epoch
+            assert state.finalized_root == prev_state.current_justified_root
+
+    yield "blocks", blocks, SSZList[spec.BeaconBlock]
+    yield "post", state
+
+
+@with_all_phases
+@never_bls
+@spec_state_test
+def test_finality_rule_1(spec, state):
+    _skip_genesis_finality_epochs(spec, state)
+    yield "pre", state
+
+    blocks = []
+    for epoch in range(3):
+        prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, False, True)
+        blocks += new_blocks
+
+        if epoch == 0:
+            check_finality(spec, state, prev_state, True, False, False)
+        elif epoch == 1:
+            check_finality(spec, state, prev_state, True, True, False)
+        else:
+            # rule 1: 2nd/3rd most recent justified, 2nd via 3rd as source
+            check_finality(spec, state, prev_state, True, True, True)
+            assert state.finalized_epoch == prev_state.previous_justified_epoch
+            assert state.finalized_root == prev_state.previous_justified_root
+
+    yield "blocks", blocks, SSZList[spec.BeaconBlock]
+    yield "post", state
+
+
+@with_all_phases
+@never_bls
+@spec_state_test
+def test_finality_rule_2(spec, state):
+    _skip_genesis_finality_epochs(spec, state)
+    yield "pre", state
+
+    blocks = []
+    for epoch in range(3):
+        if epoch == 0:
+            prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, True, False)
+            check_finality(spec, state, prev_state, True, False, False)
+        elif epoch == 1:
+            prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, False, False)
+            check_finality(spec, state, prev_state, False, True, False)
+        else:
+            prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, False, True)
+            # rule 2: 2nd/3rd/4th most recent justified, 2nd via 4th as source
+            check_finality(spec, state, prev_state, True, False, True)
+            assert state.finalized_epoch == prev_state.previous_justified_epoch
+            assert state.finalized_root == prev_state.previous_justified_root
+        blocks += new_blocks
+
+    yield "blocks", blocks, SSZList[spec.BeaconBlock]
+    yield "post", state
+
+
+@with_all_phases
+@never_bls
+@spec_state_test
+def test_finality_rule_3(spec, state):
+    """Scenario from ethereum/eth2.0-specs#611: justification skips an epoch,
+    then catches up two at once."""
+    _skip_genesis_finality_epochs(spec, state)
+    yield "pre", state
+
+    blocks = []
+    prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, True, False)
+    blocks += new_blocks
+    check_finality(spec, state, prev_state, True, False, False)
+
+    # epoch N: JE -> N, prev JE -> N-1
+    prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, True, False)
+    blocks += new_blocks
+    check_finality(spec, state, prev_state, True, True, True)
+
+    # epoch N+1: nothing gets in
+    prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, False, False)
+    blocks += new_blocks
+    check_finality(spec, state, prev_state, False, True, False)
+
+    # epoch N+2: previous-epoch messages justify N+1 (rule 2)
+    prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, False, True)
+    blocks += new_blocks
+    check_finality(spec, state, prev_state, True, False, True)
+
+    # epoch N+3: both epochs justified at once -> rule 3
+    prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, True, True)
+    blocks += new_blocks
+    check_finality(spec, state, prev_state, True, True, True)
+    assert state.finalized_epoch == prev_state.current_justified_epoch
+    assert state.finalized_root == prev_state.current_justified_root
+
+    yield "blocks", blocks, SSZList[spec.BeaconBlock]
+    yield "post", state

@@ -1,0 +1,31 @@
+"""Pytest collection shim for the dual-use spec test corpus.
+
+The corpus lives inside the package (consensus_specs_tpu/testing/spec_tests)
+so the vector generators can import the same functions; this module re-exports
+every test_* function for pytest discovery under tests/, suffixed with its
+module name to avoid cross-module shadowing (several modules define
+test_success etc.).
+"""
+import importlib
+
+_CORPUS_MODULES = [
+    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_attestation",
+    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_attester_slashing",
+    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_block_header",
+    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_deposit",
+    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_proposer_slashing",
+    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_transfer",
+    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_voluntary_exit",
+    "consensus_specs_tpu.testing.spec_tests.epoch_processing.test_process_crosslinks",
+    "consensus_specs_tpu.testing.spec_tests.epoch_processing.test_process_registry_updates",
+    "consensus_specs_tpu.testing.spec_tests.sanity.test_blocks",
+    "consensus_specs_tpu.testing.spec_tests.sanity.test_slots",
+    "consensus_specs_tpu.testing.spec_tests.test_finality",
+]
+
+for _mod_name in _CORPUS_MODULES:
+    _mod = importlib.import_module(_mod_name)
+    _suffix = _mod_name.rsplit(".", 1)[-1].removeprefix("test_")
+    for _name, _fn in list(vars(_mod).items()):
+        if _name.startswith("test_") and callable(_fn):
+            globals()[f"{_name}__{_suffix}"] = _fn
