@@ -212,6 +212,20 @@ def get_shuffled_index(spec, index: int, index_count: int, seed: bytes) -> int:
     return index
 
 
+_shuffle_backend = None
+
+
+def set_shuffle_backend(backend) -> None:
+    """Install a batched permutation backend: fn(seed, n, rounds) -> perm|None.
+
+    Returning None falls back to the numpy host path (e.g. for small n where
+    device dispatch overhead dominates). ops/shuffle.py installs the JAX
+    kernel here.
+    """
+    global _shuffle_backend
+    _shuffle_backend = backend
+
+
 def get_shuffle_permutation(spec, index_count: int, seed: bytes) -> np.ndarray:
     """perm[i] == get_shuffled_index(i, index_count, seed) for all i, batched.
 
@@ -219,10 +233,17 @@ def get_shuffle_permutation(spec, index_count: int, seed: bytes) -> np.ndarray:
     ceil(n/256) distinct position-block hashes are computed. Cached per
     (seed, n) — committees for a whole epoch reuse one permutation.
     """
+    if index_count == 0:
+        return np.empty(0, dtype=np.int64)
     key = (bytes(seed), index_count)
     cached = spec._perm_cache.get(key)
     if cached is not None:
         return cached
+    perm = None
+    if _shuffle_backend is not None:
+        perm = _shuffle_backend(bytes(seed), index_count, spec.SHUFFLE_ROUND_COUNT)
+    if perm is not None:
+        return _cache_permutation(spec, key, perm)
     n = index_count
     idx = np.arange(n, dtype=np.int64)
     n_blocks = (n + 255) // 256
@@ -239,10 +260,14 @@ def get_shuffle_permutation(spec, index_count: int, seed: bytes) -> np.ndarray:
         byte = source[position // 256, (position % 256) // 8]
         bit = (byte >> (position % 8).astype(np.uint8)) & 1
         idx = np.where(bit.astype(bool), flip, idx)
+    return _cache_permutation(spec, key, idx)
+
+
+def _cache_permutation(spec, key, perm: np.ndarray) -> np.ndarray:
     if len(spec._perm_cache) > 64:
         spec._perm_cache.clear()
-    spec._perm_cache[key] = idx
-    return idx
+    spec._perm_cache[key] = perm
+    return perm
 
 
 def compute_committee(spec, indices: Sequence[int], seed: bytes, index: int, count: int) -> List[int]:

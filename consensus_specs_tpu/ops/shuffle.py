@@ -1,0 +1,101 @@
+"""Swap-or-not shuffle as a batched JAX kernel.
+
+The reference evaluates the permutation one index at a time — 90 rounds × 2
+hashes per index (/root/reference specs/core/0_beacon-chain.md:860-882) — and
+calls it per committee slot (:884-891). Here the *whole* permutation for
+(seed, n) is one traced program: all `rounds × ceil(n/256)` position-block
+digests are produced by one batched SHA-256 dispatch on the VPU, then a
+`lax.fori_loop` carries the [n] index vector through the 90 swap rounds with
+pure gathers/selects — no data-dependent control flow, static shapes.
+
+The per-round pivots (`bytes_to_int(hash(seed+round)[:8]) % n`) are 90 scalar
+hashes of 33-byte messages; they are computed host-side (they cost nothing and
+need 64-bit modular reduction that has no business on the int32 VPU path).
+
+Index dtype is int32: n is asserted < 2**30 (the spec bound is 2**40, but a
+validator registry is millions, not billions; the one-point oracle
+`get_shuffled_index` retains full-range semantics).
+"""
+from __future__ import annotations
+
+import hashlib
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sha256 import pad_to_single_block, sha256_single_block
+
+_MAX_N = 1 << 30
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _shuffle_rounds(source_words: jnp.ndarray, pivots: jnp.ndarray, n: int) -> jnp.ndarray:
+    """source_words: [R, B, 16] padded message blocks, pivots: [R] int32 (< n).
+
+    Returns perm [n] int32 with perm[i] = image of index i.
+    """
+    rounds, n_blocks, _ = source_words.shape
+    # All R*B source digests in one batched compression: [R, B, 8] uint32.
+    digests = sha256_single_block(source_words)
+    flat = digests.reshape(rounds, n_blocks * 8)
+
+    idx0 = jnp.arange(n, dtype=jnp.int32)
+
+    def body(r, idx):
+        pivot = pivots[r]
+        flip = jnp.mod(pivot + (n - idx), n)
+        position = jnp.maximum(idx, flip)
+        # byte j of a digest lives in word j//4, big-endian within the word
+        byte_index = (position & 255) >> 3
+        word = flat[r, (position >> 8) * 8 + (byte_index >> 2)]
+        byte = (word >> (24 - 8 * (byte_index & 3)).astype(jnp.uint32)) & 0xFF
+        bit = (byte >> (position & 7).astype(jnp.uint32)) & 1
+        return jnp.where(bit == 1, flip, idx)
+
+    return jax.lax.fori_loop(0, rounds, body, idx0)
+
+
+def shuffle_permutation_device(seed: bytes, index_count: int, rounds: int) -> np.ndarray:
+    """perm[i] == get_shuffled_index(i, index_count, seed), computed on device."""
+    n = int(index_count)
+    assert 0 < n < _MAX_N
+    n_blocks = (n + 255) // 256
+
+    # Host: tiny per-round pivot hashes (R scalar sha256 calls).
+    pivots = np.empty(rounds, dtype=np.int32)
+    for r in range(rounds):
+        digest = hashlib.sha256(seed + bytes([r])).digest()
+        pivots[r] = int.from_bytes(digest[:8], "little") % n
+
+    # Host: build the [R, B] 37-byte source messages -> padded [R, B, 16] blocks.
+    msgs = np.zeros((rounds, n_blocks, 37), dtype=np.uint8)
+    seed_arr = np.frombuffer(seed, dtype=np.uint8)
+    msgs[:, :, :32] = seed_arr
+    msgs[:, :, 32] = np.arange(rounds, dtype=np.uint8)[:, None]
+    blocks_le = np.arange(n_blocks, dtype=np.uint32)[None, :]
+    msgs[:, :, 33] = blocks_le & 0xFF
+    msgs[:, :, 34] = (blocks_le >> 8) & 0xFF
+    msgs[:, :, 35] = (blocks_le >> 16) & 0xFF
+    msgs[:, :, 36] = (blocks_le >> 24) & 0xFF
+
+    words = jnp.asarray(pad_to_single_block(msgs, 37))
+    perm = _shuffle_rounds(words, jnp.asarray(pivots), n)
+    return np.asarray(perm, dtype=np.int64)
+
+
+def install_device_shuffler(min_n: int = 1 << 13) -> None:
+    """Route the spec's batched-permutation hook to the device kernel.
+
+    Below min_n the host numpy path wins (dispatch overhead dominates);
+    above it, the device runs all rounds in one program.
+    """
+    from ..models.phase0 import helpers
+
+    def backend(seed: bytes, index_count: int, rounds: int):
+        if index_count < min_n:
+            return None  # fall back to host path
+        return shuffle_permutation_device(seed, index_count, rounds)
+
+    helpers.set_shuffle_backend(backend)
