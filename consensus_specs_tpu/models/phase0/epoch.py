@@ -287,31 +287,23 @@ def process_slashings(spec, state) -> None:
             spec.decrease_balance(state, index, penalty)
 
 
-def process_final_updates(spec, state) -> None:
+def final_updates_byte_rooted(spec, state) -> None:
+    """The root/bytes writes of process_final_updates (:1526-1564): eth1-vote
+    reset, active index root, randao rotation, historical batch, attestation
+    rotation. Shared by the object-model path and the SoA device path (which
+    handles the numeric writes on device). All writes here are independent of
+    the numeric ones, so the regrouping preserves reference semantics."""
+    from ...utils.ssz.typing import List as SSZList, uint64
     current_epoch = spec.get_current_epoch(state)
     next_epoch = current_epoch + 1
     # Reset eth1 data votes
     if (state.slot + 1) % spec.SLOTS_PER_ETH1_VOTING_PERIOD == 0:
         state.eth1_data_votes = []
-    # Update effective balances with hysteresis
-    half_increment = spec.EFFECTIVE_BALANCE_INCREMENT // 2
-    for index, validator in enumerate(state.validator_registry):
-        balance = state.balances[index]
-        if balance < validator.effective_balance or validator.effective_balance + 3 * half_increment < balance:
-            validator.effective_balance = min(
-                balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT, spec.MAX_EFFECTIVE_BALANCE)
-    # Update start shard
-    state.latest_start_shard = (state.latest_start_shard
-                                + spec.get_shard_delta(state, current_epoch)) % spec.SHARD_COUNT
     # Set active index root (typ given explicitly: the list may be empty)
-    from ...utils.ssz.typing import List as SSZList, uint64
     index_root_position = (next_epoch + spec.ACTIVATION_EXIT_DELAY) % spec.LATEST_ACTIVE_INDEX_ROOTS_LENGTH
     state.latest_active_index_roots[index_root_position] = spec.hash_tree_root(
         spec.get_active_validator_indices(state, next_epoch + spec.ACTIVATION_EXIT_DELAY),
         SSZList[uint64])
-    # Set total slashed balances
-    state.latest_slashed_balances[next_epoch % spec.LATEST_SLASHED_EXIT_LENGTH] = (
-        state.latest_slashed_balances[current_epoch % spec.LATEST_SLASHED_EXIT_LENGTH])
     # Set randao mix
     state.latest_randao_mixes[next_epoch % spec.LATEST_RANDAO_MIXES_LENGTH] = \
         spec.get_randao_mix(state, current_epoch)
@@ -325,3 +317,22 @@ def process_final_updates(spec, state) -> None:
     # Rotate current/previous epoch attestations
     state.previous_epoch_attestations = state.current_epoch_attestations
     state.current_epoch_attestations = []
+
+
+def process_final_updates(spec, state) -> None:
+    current_epoch = spec.get_current_epoch(state)
+    next_epoch = current_epoch + 1
+    # Update effective balances with hysteresis
+    half_increment = spec.EFFECTIVE_BALANCE_INCREMENT // 2
+    for index, validator in enumerate(state.validator_registry):
+        balance = state.balances[index]
+        if balance < validator.effective_balance or validator.effective_balance + 3 * half_increment < balance:
+            validator.effective_balance = min(
+                balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT, spec.MAX_EFFECTIVE_BALANCE)
+    # Update start shard
+    state.latest_start_shard = (state.latest_start_shard
+                                + spec.get_shard_delta(state, current_epoch)) % spec.SHARD_COUNT
+    # Set total slashed balances
+    state.latest_slashed_balances[next_epoch % spec.LATEST_SLASHED_EXIT_LENGTH] = (
+        state.latest_slashed_balances[current_epoch % spec.LATEST_SLASHED_EXIT_LENGTH])
+    spec.final_updates_byte_rooted(state)

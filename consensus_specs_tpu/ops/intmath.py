@@ -1,0 +1,93 @@
+"""Exact 64/128-bit integer helpers for the device reward math.
+
+The reference computes rewards with Python bigints (e.g.
+`get_base_reward(...) * attesting_balance // total_balance`,
+/root/reference specs/core/0_beacon-chain.md:1398-1443, and the slashing
+penalty :1507-1524). On device those products exceed 64 bits
+(base_reward × total_balance ≈ 2^70 at mainnet scale), so the quotient is
+computed through an explicit 128-bit intermediate: a 4-limb 64×64→128
+multiply followed by restoring division. All lanes run the same fixed 64
+division steps — no data-dependent control flow.
+
+Requires jax_enable_x64 (uint64 lanes). On TPU, XLA emulates 64-bit integer
+ops with 32-bit pairs; these ops sit on [V]-shaped vectors next to the SHA-256
+Merkle work and are nowhere near the bottleneck.
+"""
+from __future__ import annotations
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+_U32_MASK = jnp.uint64(0xFFFFFFFF)
+
+
+def mulwide_u64(a: jnp.ndarray, b: jnp.ndarray):
+    """Full 64×64→128 product of uint64 arrays, as (hi, lo) uint64 pairs."""
+    a = a.astype(jnp.uint64)
+    b = b.astype(jnp.uint64)
+    a0 = a & _U32_MASK
+    a1 = a >> jnp.uint64(32)
+    b0 = b & _U32_MASK
+    b1 = b >> jnp.uint64(32)
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = p01 + p10
+    carry_mid = (mid < p01).astype(jnp.uint64)  # wrapped past 2^64
+    lo = p00 + (mid << jnp.uint64(32))
+    carry_lo = (lo < p00).astype(jnp.uint64)
+    hi = p11 + (mid >> jnp.uint64(32)) + (carry_mid << jnp.uint64(32)) + carry_lo
+    return hi, lo
+
+
+def muldiv_u64(a: jnp.ndarray, b: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
+    """Exact a * b // d on uint64 arrays, via 128-bit intermediate.
+
+    Caller guarantees the quotient fits in 64 bits (true everywhere the spec
+    divides by a total balance >= the summed numerator factor) and d >= 1.
+    Restoring division: 128-bit remainder tracked as (overflow-bit, uint64).
+    """
+    hi, lo = mulwide_u64(a, b)
+    d = jnp.broadcast_to(jnp.asarray(d, dtype=jnp.uint64), hi.shape)
+
+    def step(i, carry):
+        rem, quot = carry
+        shift = jnp.uint64(63) - jnp.asarray(i, dtype=jnp.uint64)
+        bit = (lo >> shift) & jnp.uint64(1)
+        top = rem >> jnp.uint64(63)              # bit shifted past 64
+        rem2 = (rem << jnp.uint64(1)) | bit
+        ge = (top == jnp.uint64(1)) | (rem2 >= d)
+        rem3 = jnp.where(ge, rem2 - d, rem2)     # wrapping subtract is exact when top set
+        quot2 = (quot << jnp.uint64(1)) | ge.astype(jnp.uint64)
+        return rem3, quot2
+
+    # Seed the remainder with the high word reduced mod d (hi < d whenever the
+    # quotient fits 64 bits; the mod is free insurance for hi >= d edge cases).
+    rem0 = hi % d
+    quot0 = jnp.zeros_like(hi)
+    _, quot = jax.lax.fori_loop(0, 64, step, (rem0, quot0))
+    return quot
+
+
+def isqrt_u64(n: jnp.ndarray) -> jnp.ndarray:
+    """Integer square root of uint64 arrays (reference 0_beacon-chain.md:1052-1066).
+
+    Float64 seed (exact to ~2^-52 relative) + fixed integer Newton steps +
+    final one-step corrections; exact for all n < 2^63.
+    """
+    n = jnp.asarray(n, dtype=jnp.uint64)
+    x = jnp.sqrt(n.astype(jnp.float64)).astype(jnp.uint64)
+    x = jnp.maximum(x, jnp.uint64(1))
+
+    def newton(_, x):
+        return (x + n // x) >> jnp.uint64(1)
+
+    x = jax.lax.fori_loop(0, 3, newton, x)
+    # Correct potential off-by-one from float seed / Newton floor behavior.
+    x = jnp.where(x * x > n, x - jnp.uint64(1), x)
+    x = jnp.where((x + 1) * (x + 1) <= n, x + jnp.uint64(1), x)
+    return jnp.where(n == 0, jnp.uint64(0), x)

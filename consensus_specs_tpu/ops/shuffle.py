@@ -94,8 +94,8 @@ def install_device_shuffler(min_n: int = 1 << 13) -> None:
     from ..models.phase0 import helpers
 
     def backend(seed: bytes, index_count: int, rounds: int):
-        if index_count < min_n:
-            return None  # fall back to host path
+        if index_count < min_n or index_count >= _MAX_N:
+            return None  # fall back to host path (small n, or beyond int32 range)
         return shuffle_permutation_device(seed, index_count, rounds)
 
     helpers.set_shuffle_backend(backend)
