@@ -21,7 +21,10 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
-_U32_MASK = jnp.uint64(0xFFFFFFFF)
+# Plain int (not a jnp array): creating a device array at import time would
+# initialize the JAX backend and lock in the device topology before callers
+# (tests, dryrun_multichip) can configure virtual CPU meshes.
+_U32_MASK = 0xFFFFFFFF
 
 
 def mulwide_u64(a: jnp.ndarray, b: jnp.ndarray):

@@ -1,16 +1,25 @@
-"""Swap-or-not shuffle as a batched JAX kernel.
+"""Swap-or-not shuffle as a batched, gather-free JAX kernel.
 
 The reference evaluates the permutation one index at a time — 90 rounds × 2
 hashes per index (/root/reference specs/core/0_beacon-chain.md:860-882) — and
 calls it per committee slot (:884-891). Here the *whole* permutation for
-(seed, n) is one traced program: all `rounds × ceil(n/256)` position-block
-digests are produced by one batched SHA-256 dispatch on the VPU, then a
-`lax.fori_loop` carries the [n] index vector through the 90 swap rounds with
-pure gathers/selects — no data-dependent control flow, static shapes.
+(seed, n) is one traced program.
 
-The per-round pivots (`bytes_to_int(hash(seed+round)[:8]) % n`) are 90 scalar
-hashes of 33-byte messages; they are computed host-side (they cost nothing and
-need 64-bit modular reduction that has no business on the int32 VPU path).
+TPU-native formulation: evaluating the per-index point function on all indices
+at once needs a random gather per round (bits indexed by the evolving index
+values), which XLA lowers catastrophically on TPU. Instead the kernel uses the
+*positional* form of the network: each round is an involution on positions,
+  f_r(p) = flip(p) = (pivot_r - p) mod n   iff bit_r(max(p, flip(p))),
+and `A[flip(p)]` over all p is `roll(reverse(A), pivot+1)` — contiguous memory
+movement, no gather. Composing contents C[p] <- C[f(p)] with rounds applied in
+REVERSE order yields C_final[p] = (f_{R-1} ∘ … ∘ f_0)(p) = get_shuffled_index(p)
+directly (for involutions, reverse-order content evolution composes the
+forward permutation). Per round: two reverse+rolls and two selects over [n] —
+~90 × O(n) streaming traffic, zero random access.
+
+All `rounds × ceil(n/256)` position-block digests come from one batched
+SHA-256 dispatch; per-round pivots (64-bit modular reduction of 33-byte
+hashes) are computed host-side where bignum mod is free.
 
 Index dtype is int32: n is asserted < 2**30 (the spec bound is 2**40, but a
 validator registry is millions, not billions; the one-point oracle
@@ -25,64 +34,90 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .sha256 import pad_to_single_block, sha256_single_block
+from .sha256 import bytes_to_words, sha256_single_block
 
 _MAX_N = 1 << 30
 
 
-@partial(jax.jit, static_argnames=("n",))
-def _shuffle_rounds(source_words: jnp.ndarray, pivots: jnp.ndarray, n: int) -> jnp.ndarray:
-    """source_words: [R, B, 16] padded message blocks, pivots: [R] int32 (< n).
+@partial(jax.jit, static_argnames=("n", "rounds"))
+def _shuffle_rounds(seed_words: jnp.ndarray, pivots: jnp.ndarray, n: int, rounds: int) -> jnp.ndarray:
+    """seed_words: [8] uint32 (big-endian seed), pivots: [R] int32 (< n).
 
-    Returns perm [n] int32 with perm[i] = image of index i.
+    Returns perm [n] int32 with perm[p] = image of index p under the shuffle.
+    The [R, B, 16] single-block SHA-256 messages (seed ‖ round ‖ block_index,
+    37 bytes + padding) are assembled on device — the host ships 32 bytes, not
+    megabytes (host↔device bandwidth is the scarce resource, not VPU cycles).
     """
-    rounds, n_blocks, _ = source_words.shape
+    n_blocks = (n + 255) // 256
+    # Message layout (big-endian words): w0..w7 = seed; byte32 = round,
+    # bytes 33..36 = block index little-endian, byte 37 = 0x80 terminator,
+    # w15 = bit length (37*8). Build via broadcasting over [R, B].
+    blk = jnp.arange(n_blocks, dtype=jnp.uint32)[None, :]            # [1, B]
+    rnd = jnp.arange(rounds, dtype=jnp.uint32)[:, None]              # [R, 1]
+    w8 = (rnd << 24) | ((blk & 0xFF) << 16) | (((blk >> 8) & 0xFF) << 8) | ((blk >> 16) & 0xFF)
+    w9 = jnp.broadcast_to((((blk >> 24) & 0xFF) << 24) | jnp.uint32(0x80 << 16),
+                          (rounds, n_blocks))
+    zeros = jnp.zeros((rounds, n_blocks), dtype=jnp.uint32)
+    w15 = jnp.full((rounds, n_blocks), 37 * 8, dtype=jnp.uint32)
+    seed_bcast = [jnp.broadcast_to(seed_words[i], (rounds, n_blocks)) for i in range(8)]
+    source_words = jnp.stack(
+        seed_bcast + [w8, w9, zeros, zeros, zeros, zeros, zeros, w15], axis=-1)
     # All R*B source digests in one batched compression: [R, B, 8] uint32.
     digests = sha256_single_block(source_words)
-    flat = digests.reshape(rounds, n_blocks * 8)
+    # Expand to per-position bits [R, n]: byte j of a digest is word j//4,
+    # big-endian within the word; bit k of byte j decides position 8j+k.
+    # word w, byte-in-word b (big-endian): byte = w >> (24-8b); bit k: >> k.
+    shifts = (24 - 8 * (np.arange(32, dtype=np.uint32) // 8 % 4)  # byte shift
+              + np.arange(32, dtype=np.uint32) % 8)               # bit shift
+    # positions within a word: j = 4*word_byte_index... Layout: digest word d
+    # covers bytes 4d..4d+3 -> positions 32d..32d+31 with byte-major order.
+    bits = (digests[..., :, None] >> shifts.astype(jnp.uint32)) & jnp.uint32(1)
+    bits = bits.reshape(rounds, n_blocks * 256)[:, :n].astype(jnp.bool_)
 
-    idx0 = jnp.arange(n, dtype=jnp.int32)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    C0 = pos
 
-    def body(r, idx):
+    def body(k, C):
+        r = rounds - 1 - k  # reverse round order -> forward permutation
         pivot = pivots[r]
-        flip = jnp.mod(pivot + (n - idx), n)
-        position = jnp.maximum(idx, flip)
-        # byte j of a digest lives in word j//4, big-endian within the word
-        byte_index = (position & 255) >> 3
-        word = flat[r, (position >> 8) * 8 + (byte_index >> 2)]
-        byte = (word >> (24 - 8 * (byte_index & 3)).astype(jnp.uint32)) & 0xFF
-        bit = (byte >> (position & 7).astype(jnp.uint32)) & 1
-        return jnp.where(bit == 1, flip, idx)
+        flip = pivot - pos
+        flip = jnp.where(flip < 0, flip + n, flip)
+        # X[flip(p)] for all p == roll(reverse(X), pivot+1)
+        shift = pivot + 1
+        C_flip = jnp.roll(C[::-1], shift)
+        bits_r = bits[r]
+        bits_flip = jnp.roll(bits_r[::-1], shift)
+        # decision bit lives at max(p, flip(p))
+        bit_at_max = jnp.where(pos >= flip, bits_r, bits_flip)
+        return jnp.where(bit_at_max, C_flip, C)
 
-    return jax.lax.fori_loop(0, rounds, body, idx0)
+    return jax.lax.fori_loop(0, rounds, body, C0)
 
 
-def shuffle_permutation_device(seed: bytes, index_count: int, rounds: int) -> np.ndarray:
-    """perm[i] == get_shuffled_index(i, index_count, seed), computed on device."""
+def shuffle_permutation_on_device(seed: bytes, index_count: int, rounds: int) -> jnp.ndarray:
+    """perm[i] == get_shuffled_index(i, index_count, seed), as a DEVICE array.
+
+    The device-resident entry point for jitted pipelines (committee slicing,
+    epoch processing): nothing but the 32-byte seed and 90 pivots crosses the
+    host↔device boundary. Use shuffle_permutation_device for a numpy result.
+    """
     n = int(index_count)
     assert 0 < n < _MAX_N
-    n_blocks = (n + 255) // 256
 
-    # Host: tiny per-round pivot hashes (R scalar sha256 calls).
+    # Host: tiny per-round pivot hashes (R scalar sha256 calls; 64-bit
+    # modular reduction is free in Python bignums).
     pivots = np.empty(rounds, dtype=np.int32)
     for r in range(rounds):
         digest = hashlib.sha256(seed + bytes([r])).digest()
         pivots[r] = int.from_bytes(digest[:8], "little") % n
 
-    # Host: build the [R, B] 37-byte source messages -> padded [R, B, 16] blocks.
-    msgs = np.zeros((rounds, n_blocks, 37), dtype=np.uint8)
-    seed_arr = np.frombuffer(seed, dtype=np.uint8)
-    msgs[:, :, :32] = seed_arr
-    msgs[:, :, 32] = np.arange(rounds, dtype=np.uint8)[:, None]
-    blocks_le = np.arange(n_blocks, dtype=np.uint32)[None, :]
-    msgs[:, :, 33] = blocks_le & 0xFF
-    msgs[:, :, 34] = (blocks_le >> 8) & 0xFF
-    msgs[:, :, 35] = (blocks_le >> 16) & 0xFF
-    msgs[:, :, 36] = (blocks_le >> 24) & 0xFF
+    seed_words = jnp.asarray(bytes_to_words(np.frombuffer(seed, dtype=np.uint8)))
+    return _shuffle_rounds(seed_words, jnp.asarray(pivots), n, rounds)
 
-    words = jnp.asarray(pad_to_single_block(msgs, 37))
-    perm = _shuffle_rounds(words, jnp.asarray(pivots), n)
-    return np.asarray(perm, dtype=np.int64)
+
+def shuffle_permutation_device(seed: bytes, index_count: int, rounds: int) -> np.ndarray:
+    """Host-facing wrapper: same permutation, materialized as numpy int64."""
+    return np.asarray(shuffle_permutation_on_device(seed, index_count, rounds), dtype=np.int64)
 
 
 def install_device_shuffler(min_n: int = 1 << 13) -> None:

@@ -7,9 +7,13 @@ virtual devices per the multi-chip test strategy.
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# jax >= 0.9: the old XLA_FLAGS --xla_force_host_platform_device_count is a
+# no-op; the supported way to get virtual CPU devices is the config flag,
+# set before the backend initializes (i.e. before any test imports jax).
+import jax  # noqa: E402
+
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 
