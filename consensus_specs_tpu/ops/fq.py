@@ -1,0 +1,272 @@
+"""Batched BLS12-381 base-field arithmetic in JAX: Montgomery form, 29-bit limbs.
+
+The reference delegates all field math to pure-Python bignums (py_ecc there,
+crypto/bls12_381.py here — /root/reference specs/bls_signature.md:96-146 for
+the contract). On TPU there is no wide multiplier, so an Fq element is a
+`[..., 14]` uint64 array of 29-bit limbs (14×29 = 406 ≥ 381 bits): limb
+products are ≤ 2^58, so a full 27-column schoolbook accumulation (≤ 14 terms
+per column, < 2^62) and the interleaved Montgomery reduction both fit uint64
+lanes with headroom. The batch dimensions are where the VPU parallelism is —
+every function is elementwise over leading axes and jit-composable.
+
+Values are kept in Montgomery form (aR mod q, R = 2^406) everywhere on
+device; conversion happens at the host boundary only. All inputs/outputs are
+normalized: limbs < 2^29, value < q.
+
+No data-dependent control flow: fixed-length carry chains, compare-select
+conditional subtracts, fori_loop exponentiation over static bit arrays.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import numpy as np
+
+from . import intmath  # noqa: F401  (enables jax_enable_x64 before jnp use)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+Q = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+B = 29                      # bits per limb
+L = 14                      # limbs (14*29 = 406 bits)
+MASK = (1 << B) - 1
+R_MONT = (1 << (B * L)) % Q
+R2_MONT = (R_MONT * R_MONT) % Q
+QINV_NEG = pow(-Q, -1, 1 << B)   # -q^{-1} mod 2^B (Montgomery constant)
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host: python int -> [L] uint64 limb array (little-endian, 29-bit)."""
+    out = np.zeros(L, dtype=np.uint64)
+    for i in range(L):
+        out[i] = (x >> (B * i)) & MASK
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    """Host: [L] limb array -> python int."""
+    arr = np.asarray(limbs, dtype=np.uint64)
+    return sum(int(arr[..., i]) << (B * i) for i in range(L))
+
+
+Q_LIMBS = int_to_limbs(Q)
+_Q_CONST = tuple(int(v) for v in Q_LIMBS)
+
+
+def to_mont(x: int) -> np.ndarray:
+    """Host: int -> Montgomery-form limb array (for staging constants)."""
+    return int_to_limbs((x % Q) * R_MONT % Q)
+
+
+def from_mont(limbs) -> int:
+    """Host: Montgomery-form limb array -> canonical int."""
+    return limbs_to_int(limbs) * pow(R_MONT, -1, Q) % Q
+
+
+def stack_mont(values: Sequence[int]) -> np.ndarray:
+    """Host: [N] ints -> [N, L] Montgomery limb arrays."""
+    return np.stack([to_mont(v) for v in values])
+
+
+# ---------------------------------------------------------------------------
+# Normalization / comparison primitives (device)
+# ---------------------------------------------------------------------------
+
+def _carry_norm(t):
+    """Propagate carries left-to-right; limbs end < 2^B. Input limbs < 2^63."""
+    out = []
+    carry = jnp.zeros_like(t[..., 0])
+    for i in range(t.shape[-1]):
+        v = t[..., i] + carry
+        out.append(v & jnp.uint64(MASK))
+        carry = v >> jnp.uint64(B)
+    return jnp.stack(out, axis=-1), carry
+
+
+def _geq(a, b_const):
+    """a >= b for normalized limbs vs a static limb tuple, lexicographic."""
+    gt_any = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
+    lt_any = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
+    for i in reversed(range(L)):  # most significant limb first
+        bi = jnp.uint64(b_const[i])
+        undecided = ~gt_any & ~lt_any
+        gt_any = gt_any | ((a[..., i] > bi) & undecided)
+        lt_any = lt_any | ((a[..., i] < bi) & undecided)
+    return ~lt_any  # gt_any or all-equal
+
+
+def _sub_const(a, b_const):
+    """a - b_const for normalized a >= b_const (borrow chain)."""
+    out = []
+    borrow = jnp.zeros_like(a[..., 0])
+    for i in range(L):
+        v = a[..., i] + jnp.uint64((1 << B)) - jnp.uint64(b_const[i]) - borrow
+        out.append(v & jnp.uint64(MASK))
+        borrow = jnp.uint64(1) - (v >> jnp.uint64(B))
+    return jnp.stack(out, axis=-1)
+
+
+def _cond_sub_q(a):
+    """a mod q for a < 2q (normalized limbs)."""
+    need = _geq(a, _Q_CONST)
+    sub = _sub_const(a, _Q_CONST)
+    return jnp.where(need[..., None], sub, a)
+
+
+# ---------------------------------------------------------------------------
+# Field ops (device; inputs normalized & < q, Montgomery form where relevant)
+# ---------------------------------------------------------------------------
+
+def fq_add(a, b):
+    t, _ = _carry_norm(a + b)
+    return _cond_sub_q(t)
+
+
+def _sub_arr(a, b):
+    """a - b for normalized limbs with value(a) >= value(b); borrow chain."""
+    out = []
+    borrow = jnp.zeros_like(a[..., 0])
+    for i in range(a.shape[-1]):
+        v = a[..., i] + jnp.uint64(1 << B) - b[..., i] - borrow
+        out.append(v & jnp.uint64(MASK))
+        borrow = jnp.uint64(1) - (v >> jnp.uint64(B))
+    return jnp.stack(out, axis=-1)
+
+
+_Q_NP = np.asarray(Q_LIMBS, dtype=np.uint64)  # numpy: no device array at import
+
+
+def _q_arr():
+    # jnp.asarray of a numpy constant inside a trace embeds it as a constant;
+    # caching a jnp array would leak tracers across jit boundaries.
+    return jnp.asarray(_Q_NP)
+
+
+def fq_sub(a, b):
+    # (a + q) - b: a+q normalizes to < 2q which still fits 14 limbs (2q < 2^383)
+    s, _ = _carry_norm(a + _q_arr())
+    t = _sub_arr(s, b)
+    return _cond_sub_q(t)
+
+
+def fq_neg(a):
+    # q - a, folded back to [0, q) (maps 0 -> q -> 0 via the conditional sub)
+    t = _sub_arr(jnp.broadcast_to(_q_arr(), a.shape), a)
+    return _cond_sub_q(t)
+
+
+# Static shifted copies of q's limbs (limb 0 dropped — it is folded into the
+# running carry): row i holds q[1..13] placed at columns i+1..i+13 of a 2L grid.
+_Q_SHIFTS = np.zeros((L, 2 * L), dtype=np.uint64)
+for _i in range(L):
+    _Q_SHIFTS[_i, _i + 1:_i + L] = np.asarray(Q_LIMBS[1:], dtype=np.uint64)
+
+
+def fq_mul(a, b):
+    """Montgomery product: a*b*R^-1 mod q. a, b normalized < q.
+
+    Column bound: schoolbook columns < 14·2^58, plus ≤14 reduction terms
+    ≤ 2^62.7 — inside uint64. Result < 2q, folded by one conditional subtract.
+
+    Compile-friendliness matters as much as runtime here: every step is a
+    whole-[2L]-vector op (shifted adds against static masks, no per-limb
+    scatter), so one fq_mul is ~200 HLO ops. Tower multiplications stack all
+    their Karatsuba leaf products into a single fq_mul call, so even an Fq12
+    product costs one instance of this graph.
+    """
+    batch = a.shape[:-1]
+    # Phase 1: 28 column sums of the schoolbook product via shifted adds
+    zero_l = jnp.zeros(batch + (L,), dtype=jnp.uint64)
+    b_pad = jnp.concatenate([b, zero_l], axis=-1)           # [..., 2L]
+    cols = jnp.zeros(batch + (2 * L,), dtype=jnp.uint64)
+    for i in range(L):
+        shifted = jnp.concatenate(
+            [jnp.zeros(batch + (i,), dtype=jnp.uint64), b,
+             jnp.zeros(batch + (L - i,), dtype=jnp.uint64)], axis=-1)
+        cols = cols + a[..., i:i + 1] * shifted
+    del b_pad
+    # Phase 2: interleaved Montgomery reduction with a running carry;
+    # the m*q additions use static pre-shifted copies of q's limbs.
+    carry = jnp.zeros(batch, dtype=jnp.uint64)
+    qinv = jnp.uint64(QINV_NEG)
+    mask = jnp.uint64(MASK)
+    for i in range(L):
+        v = cols[..., i] + carry
+        m = (v & mask) * qinv & mask
+        # v + m*q0 is divisible by 2^B; fold its carry forward
+        carry = (v + m * jnp.uint64(_Q_CONST[0])) >> jnp.uint64(B)
+        cols = cols + m[..., None] * jnp.asarray(_Q_SHIFTS[i])
+    # Upper half + final carry propagation (no carry out: value < 2q < 2^406)
+    upper = cols[..., L:].at[..., 0].add(carry)
+    t, _top = _carry_norm(upper)
+    return _cond_sub_q(t)
+
+
+def fq_sqr(a):
+    return fq_mul(a, a)
+
+
+def fq_select(cond, a, b):
+    """where(cond, a, b) broadcasting cond over the limb axis."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def fq_is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def fq_eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def fq_zeros(shape=()):
+    return jnp.zeros(tuple(shape) + (L,), dtype=jnp.uint64)
+
+
+def fq_ones(shape=()):
+    """Montgomery one (R mod q), broadcast to shape."""
+    one = jnp.asarray(to_mont(1))
+    return jnp.broadcast_to(one, tuple(shape) + (L,))
+
+
+def _exp_bits(e: int) -> np.ndarray:
+    """Static exponent -> bit array (MSB first) for fori_loop exponentiation."""
+    bits = bin(e)[2:]
+    return np.frombuffer(bits.encode(), dtype=np.uint8) - ord("0")
+
+
+_INV_EXP_BITS = _exp_bits(Q - 2)
+_SQRT_EXP_BITS = _exp_bits((Q + 1) // 4)
+
+
+def _fq_pow_static(a, bits_np: np.ndarray):
+    """a^e with e given as a static bit array; fori over bits, cond multiply."""
+    bits = jnp.asarray(bits_np.astype(np.uint8))
+    n = int(bits_np.shape[0])
+
+    def body(i, acc):
+        acc = fq_mul(acc, acc)
+        mul = fq_mul(acc, a)
+        return fq_select(bits[i] == 1, mul, acc)
+
+    return jax.lax.fori_loop(0, n, body, fq_ones(a.shape[:-1]))
+
+
+def fq_inv(a):
+    """a^(q-2) — batched Fermat inversion (Montgomery in, Montgomery out)."""
+    return _fq_pow_static(a, _INV_EXP_BITS)
+
+
+def fq_sqrt_candidate(a):
+    """a^((q+1)/4): THE square root if a is a QR (q ≡ 3 mod 4); else garbage.
+
+    Caller must check candidate^2 == a (reference decompress_g1,
+    crypto/bls12_381.py:361-378 does the same check).
+    """
+    return _fq_pow_static(a, _SQRT_EXP_BITS)

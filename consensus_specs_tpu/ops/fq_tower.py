@@ -1,0 +1,297 @@
+"""Batched Fq2/Fq6/Fq12 tower arithmetic in JAX (BLS12-381 pairing support).
+
+Mirrors the ground-truth tower in crypto/bls12_381.py (same Karatsuba
+structure, same reduction constants) over limb arrays:
+
+    Fq2  = Fq[u]/(u^2+1)        -> [..., 2, L]
+    Fq6  = Fq2[v]/(v^3 - (1+u)) -> [..., 3, 2, L]
+    Fq12 = Fq6[w]/(w^2 - v)     -> [..., 2, 3, 2, L]
+
+plus Frobenius maps f -> f^(q^k) via host-precomputed coefficient tables
+(basis element v^i w^j = w^(2i+j) picks up xi^((q^k-1)(2i+j)/6)).
+
+All ops are elementwise over leading batch axes, Montgomery form throughout.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto import bls12_381 as gt  # ground truth for constants only
+from . import fq as F
+
+import jax.numpy as jnp  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Host converters (staging values / constants)
+# ---------------------------------------------------------------------------
+
+def fq2_to_limbs(x: gt.Fq2) -> np.ndarray:
+    return np.stack([F.to_mont(x.c0), F.to_mont(x.c1)])
+
+
+def fq2_from_limbs(a) -> gt.Fq2:
+    a = np.asarray(a)
+    return gt.Fq2(F.from_mont(a[0]), F.from_mont(a[1]))
+
+
+def fq6_to_limbs(x: gt.Fq6) -> np.ndarray:
+    return np.stack([fq2_to_limbs(x.c0), fq2_to_limbs(x.c1), fq2_to_limbs(x.c2)])
+
+
+def fq6_from_limbs(a) -> gt.Fq6:
+    a = np.asarray(a)
+    return gt.Fq6(*(fq2_from_limbs(a[i]) for i in range(3)))
+
+
+def fq12_to_limbs(x: gt.Fq12) -> np.ndarray:
+    return np.stack([fq6_to_limbs(x.c0), fq6_to_limbs(x.c1)])
+
+
+def fq12_from_limbs(a) -> gt.Fq12:
+    a = np.asarray(a)
+    return gt.Fq12(fq6_from_limbs(a[0]), fq6_from_limbs(a[1]))
+
+
+# ---------------------------------------------------------------------------
+# Fq2  [..., 2, L]
+# ---------------------------------------------------------------------------
+
+def fq2(c0, c1):
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fq2_add(a, b):
+    return fq2(F.fq_add(a[..., 0, :], b[..., 0, :]), F.fq_add(a[..., 1, :], b[..., 1, :]))
+
+
+def fq2_sub(a, b):
+    return fq2(F.fq_sub(a[..., 0, :], b[..., 0, :]), F.fq_sub(a[..., 1, :], b[..., 1, :]))
+
+
+def fq2_neg(a):
+    return fq2(F.fq_neg(a[..., 0, :]), F.fq_neg(a[..., 1, :]))
+
+
+def fq2_conj(a):
+    return fq2(a[..., 0, :], F.fq_neg(a[..., 1, :]))
+
+
+def fq2_mul(a, b):
+    # (a0 + a1 u)(b0 + b1 u) = a0b0 - a1b1 + ((a0+a1)(b0+b1) - a0b0 - a1b1) u
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    t0 = F.fq_mul(a0, b0)
+    t1 = F.fq_mul(a1, b1)
+    t2 = F.fq_mul(F.fq_add(a0, a1), F.fq_add(b0, b1))
+    return fq2(F.fq_sub(t0, t1), F.fq_sub(t2, F.fq_add(t0, t1)))
+
+
+def fq2_sqr(a):
+    # (a + bu)^2 = (a+b)(a-b) + 2ab u
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return fq2(
+        F.fq_mul(F.fq_add(a0, a1), F.fq_sub(a0, a1)),
+        F.fq_mul(F.fq_add(a0, a0), a1),
+    )
+
+
+def fq2_scale(a, s):
+    """a * s with s an Fq element [..., L]."""
+    return fq2(F.fq_mul(a[..., 0, :], s), F.fq_mul(a[..., 1, :], s))
+
+
+def fq2_mul_xi(a):
+    # (1 + u)(c0 + c1 u) = (c0 - c1) + (c0 + c1) u
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return fq2(F.fq_sub(a0, a1), F.fq_add(a0, a1))
+
+
+def fq2_inv(a):
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    norm = F.fq_add(F.fq_mul(a0, a0), F.fq_mul(a1, a1))
+    inv_norm = F.fq_inv(norm)
+    return fq2(F.fq_mul(a0, inv_norm), F.fq_neg(F.fq_mul(a1, inv_norm)))
+
+
+def fq2_is_zero(a):
+    return F.fq_is_zero(a[..., 0, :]) & F.fq_is_zero(a[..., 1, :])
+
+
+def fq2_eq(a, b):
+    return F.fq_eq(a[..., 0, :], b[..., 0, :]) & F.fq_eq(a[..., 1, :], b[..., 1, :])
+
+
+def fq2_select(cond, a, b):
+    return jnp.where(cond[..., None, None], a, b)
+
+
+def fq2_zeros(shape=()):
+    return jnp.zeros(tuple(shape) + (2, F.L), dtype=jnp.uint64)
+
+
+def fq2_ones(shape=()):
+    return jnp.broadcast_to(jnp.asarray(fq2_to_limbs(gt.FQ2_ONE)), tuple(shape) + (2, F.L))
+
+
+# ---------------------------------------------------------------------------
+# Fq6  [..., 3, 2, L]
+# ---------------------------------------------------------------------------
+
+def fq6(c0, c1, c2):
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def _c(a, i):
+    return a[..., i, :, :]
+
+
+def fq6_add(a, b):
+    return fq6(*(fq2_add(_c(a, i), _c(b, i)) for i in range(3)))
+
+
+def fq6_sub(a, b):
+    return fq6(*(fq2_sub(_c(a, i), _c(b, i)) for i in range(3)))
+
+
+def fq6_neg(a):
+    return fq6(*(fq2_neg(_c(a, i)) for i in range(3)))
+
+
+def fq6_mul(a, b):
+    # Same structure as ground truth Fq6.__mul__ (bls12_381.py:148-156)
+    a0, a1, a2 = _c(a, 0), _c(a, 1), _c(a, 2)
+    b0, b1, b2 = _c(b, 0), _c(b, 1), _c(b, 2)
+    t0, t1, t2 = fq2_mul(a0, b0), fq2_mul(a1, b1), fq2_mul(a2, b2)
+    c0 = fq2_add(t0, fq2_mul_xi(
+        fq2_sub(fq2_mul(fq2_add(a1, a2), fq2_add(b1, b2)), fq2_add(t1, t2))))
+    c1 = fq2_add(
+        fq2_sub(fq2_mul(fq2_add(a0, a1), fq2_add(b0, b1)), fq2_add(t0, t1)),
+        fq2_mul_xi(t2))
+    c2 = fq2_add(
+        fq2_sub(fq2_mul(fq2_add(a0, a2), fq2_add(b0, b2)), fq2_add(t0, t2)),
+        t1)
+    return fq6(c0, c1, c2)
+
+
+def fq6_sqr(a):
+    return fq6_mul(a, a)
+
+
+def fq6_scale_fq2(a, s):
+    return fq6(*(fq2_mul(_c(a, i), s) for i in range(3)))
+
+
+def fq6_mul_by_v(a):
+    # (c0 + c1 v + c2 v^2) v = c2 xi + c0 v + c1 v^2
+    return fq6(fq2_mul_xi(_c(a, 2)), _c(a, 0), _c(a, 1))
+
+
+def fq6_inv(a):
+    a0, a1, a2 = _c(a, 0), _c(a, 1), _c(a, 2)
+    t0 = fq2_sub(fq2_sqr(a0), fq2_mul_xi(fq2_mul(a1, a2)))
+    t1 = fq2_sub(fq2_mul_xi(fq2_sqr(a2)), fq2_mul(a0, a1))
+    t2 = fq2_sub(fq2_sqr(a1), fq2_mul(a0, a2))
+    denom = fq2_add(
+        fq2_mul(a0, t0),
+        fq2_mul_xi(fq2_add(fq2_mul(a2, t1), fq2_mul(a1, t2))))
+    inv_d = fq2_inv(denom)
+    return fq6(fq2_mul(t0, inv_d), fq2_mul(t1, inv_d), fq2_mul(t2, inv_d))
+
+
+def fq6_zeros(shape=()):
+    return jnp.zeros(tuple(shape) + (3, 2, F.L), dtype=jnp.uint64)
+
+
+def fq6_select(cond, a, b):
+    return jnp.where(cond[..., None, None, None], a, b)
+
+
+# ---------------------------------------------------------------------------
+# Fq12  [..., 2, 3, 2, L]
+# ---------------------------------------------------------------------------
+
+def fq12(c0, c1):
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def _h(a, i):
+    return a[..., i, :, :, :]
+
+
+def fq12_add(a, b):
+    return fq12(fq6_add(_h(a, 0), _h(b, 0)), fq6_add(_h(a, 1), _h(b, 1)))
+
+
+def fq12_mul(a, b):
+    a0, a1 = _h(a, 0), _h(a, 1)
+    b0, b1 = _h(b, 0), _h(b, 1)
+    t0 = fq6_mul(a0, b0)
+    t1 = fq6_mul(a1, b1)
+    mid = fq6_sub(fq6_mul(fq6_add(a0, a1), fq6_add(b0, b1)), fq6_add(t0, t1))
+    return fq12(fq6_add(t0, fq6_mul_by_v(t1)), mid)
+
+
+def fq12_sqr(a):
+    return fq12_mul(a, a)
+
+
+def fq12_conj(a):
+    return fq12(_h(a, 0), fq6_neg(_h(a, 1)))
+
+
+def fq12_inv(a):
+    a0, a1 = _h(a, 0), _h(a, 1)
+    denom = fq6_sub(fq6_mul(a0, a0), fq6_mul_by_v(fq6_mul(a1, a1)))
+    inv_d = fq6_inv(denom)
+    return fq12(fq6_mul(a0, inv_d), fq6_neg(fq6_mul(a1, inv_d)))
+
+
+def fq12_select(cond, a, b):
+    return jnp.where(cond[..., None, None, None, None], a, b)
+
+
+def fq12_eq(a, b):
+    return jnp.all(a == b, axis=(-1, -2, -3, -4))
+
+
+def fq12_ones(shape=()):
+    return jnp.broadcast_to(
+        jnp.asarray(fq12_to_limbs(gt.FQ12_ONE)), tuple(shape) + (2, 3, 2, F.L))
+
+
+# ---------------------------------------------------------------------------
+# Frobenius: f -> f^(q^k), k = 1..3
+# ---------------------------------------------------------------------------
+# Basis element v^i w^j = w^(2i+j); (w^e)^(q^k) = xi^(e(q^k-1)/6) w^e, and the
+# Fq2 coefficient maps through conj() for odd k. Tables computed with the
+# ground-truth bignum tower at import (host, cheap).
+
+def _frob_tables():
+    tables = {}
+    for k in (1, 2, 3):
+        coeffs = np.zeros((2, 3, 2, 2, F.L), dtype=np.uint64)  # [j?][i]... see below
+        for i in range(3):
+            for j in range(2):
+                e = 2 * i + j
+                gamma = gt.XI ** ((gt.q ** k - 1) * e // 6)
+                coeffs[j, i] = fq2_to_limbs(gamma)
+        tables[k] = coeffs
+    return tables
+
+
+_FROB = _frob_tables()
+
+
+def fq12_frobenius(a, k: int):
+    coeffs = _FROB[k]
+    parts = []
+    for j in range(2):       # w-degree
+        comps = []
+        for i in range(3):   # v-degree
+            c = a[..., j, i, :, :]
+            if k % 2 == 1:
+                c = fq2_conj(c)
+            comps.append(fq2_mul(c, jnp.asarray(coeffs[j, i])))
+        parts.append(fq6(*comps))
+    return fq12(*parts)
