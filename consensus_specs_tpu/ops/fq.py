@@ -180,7 +180,10 @@ def fq_mul(a, b):
     their Karatsuba leaf products into a single fq_mul call, so even an Fq12
     product costs one instance of this graph.
     """
-    batch = a.shape[:-1]
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+    batch = shape[:-1]
     # Phase 1: 28 column sums of the schoolbook product via shifted adds
     zero_l = jnp.zeros(batch + (L,), dtype=jnp.uint64)
     b_pad = jnp.concatenate([b, zero_l], axis=-1)           # [..., 2L]

@@ -270,7 +270,7 @@ def fq12_ones(shape=()):
 def _frob_tables():
     tables = {}
     for k in (1, 2, 3):
-        coeffs = np.zeros((2, 3, 2, 2, F.L), dtype=np.uint64)  # [j?][i]... see below
+        coeffs = np.zeros((2, 3, 2, F.L), dtype=np.uint64)  # [w-deg j][v-deg i][Fq2 limbs]
         for i in range(3):
             for j in range(2):
                 e = 2 * i + j

@@ -6,7 +6,10 @@ virtual devices per the multi-chip test strategy.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment points JAX_PLATFORMS at the TPU relay,
+# but the test suite is defined to run on a virtual 8-device CPU mesh
+# (bench.py is the TPU consumer). setdefault is not enough — override.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 # jax >= 0.9: the old XLA_FLAGS --xla_force_host_platform_device_count is a
 # no-op; the supported way to get virtual CPU devices is the config flag,

@@ -1,0 +1,165 @@
+"""Differential tests: JAX limb-array field tower vs the bignum ground truth.
+
+Every op in ops/fq.py and ops/fq_tower.py is checked bit-for-bit against
+crypto/bls12_381.py on random values and the edge cases 0, 1, q-1. These are
+the building blocks of the TPU pairing (ops/bls_jax.py); a subtle Montgomery
+or Frobenius bug here corrupts every signature check above, so the tower gets
+its own oracle suite (the gap VERDICT/ADVICE round 1 flagged).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.crypto import bls12_381 as gt
+from consensus_specs_tpu.ops import fq as F
+from consensus_specs_tpu.ops import fq_tower as T
+
+rng = random.Random(0xB15)
+
+EDGE = [0, 1, gt.q - 1]
+
+
+def rand_fq():
+    return rng.randrange(gt.q)
+
+
+def fq_batch(values):
+    """ints -> [N, L] Montgomery device array."""
+    return np.stack([F.to_mont(v) for v in values])
+
+
+def fq_out(arr):
+    return [F.from_mont(np.asarray(arr)[i]) for i in range(np.asarray(arr).shape[0])]
+
+
+# ---------------------------------------------------------------------------
+# Fq
+# ---------------------------------------------------------------------------
+
+def test_fq_roundtrip():
+    vals = EDGE + [rand_fq() for _ in range(5)]
+    assert fq_out(fq_batch(vals)) == vals
+
+
+def test_fq_add_sub_neg():
+    a_vals = EDGE + [rand_fq() for _ in range(8)]
+    b_vals = [rand_fq() for _ in range(len(a_vals) - 1)] + [gt.q - 1]
+    a, b = fq_batch(a_vals), fq_batch(b_vals)
+    assert fq_out(F.fq_add(a, b)) == [(x + y) % gt.q for x, y in zip(a_vals, b_vals)]
+    assert fq_out(F.fq_sub(a, b)) == [(x - y) % gt.q for x, y in zip(a_vals, b_vals)]
+    assert fq_out(F.fq_neg(a)) == [(-x) % gt.q for x in a_vals]
+
+
+def test_fq_mul():
+    a_vals = EDGE + [rand_fq() for _ in range(8)]
+    b_vals = [gt.q - 1, 1, 0] + [rand_fq() for _ in range(8)]
+    out = fq_out(F.fq_mul(fq_batch(a_vals), fq_batch(b_vals)))
+    assert out == [x * y % gt.q for x, y in zip(a_vals, b_vals)]
+
+
+def test_fq_inv():
+    vals = [1, gt.q - 1] + [rand_fq() for _ in range(4)]
+    out = fq_out(F.fq_inv(fq_batch(vals)))
+    assert out == [pow(v, -1, gt.q) for v in vals]
+
+
+def test_fq_sqrt_candidate():
+    # squares -> candidate recovers a root; non-residues -> candidate fails check
+    sq = [pow(rand_fq(), 2, gt.q) for _ in range(4)]
+    cands = fq_out(F.fq_sqrt_candidate(fq_batch(sq)))
+    for v, c in zip(sq, cands):
+        assert c * c % gt.q == v
+    # find a non-residue (Euler criterion) and confirm the candidate is garbage
+    while True:
+        nr = rand_fq()
+        if pow(nr, (gt.q - 1) // 2, gt.q) == gt.q - 1:
+            break
+    c = fq_out(F.fq_sqrt_candidate(fq_batch([nr])))[0]
+    assert c * c % gt.q != nr
+
+
+# ---------------------------------------------------------------------------
+# Fq2 / Fq6 / Fq12
+# ---------------------------------------------------------------------------
+
+def rand_fq2():
+    return gt.Fq2(rand_fq(), rand_fq())
+
+
+def rand_fq6():
+    return gt.Fq6(rand_fq2(), rand_fq2(), rand_fq2())
+
+
+def rand_fq12():
+    return gt.Fq12(rand_fq6(), rand_fq6())
+
+
+def fq2_batch(vals):
+    return np.stack([T.fq2_to_limbs(v) for v in vals])
+
+
+def fq2_out(arr):
+    arr = np.asarray(arr)
+    return [T.fq2_from_limbs(arr[i]) for i in range(arr.shape[0])]
+
+
+def test_fq2_ops():
+    a_vals = [gt.FQ2_ZERO, gt.FQ2_ONE, gt.XI] + [rand_fq2() for _ in range(5)]
+    b_vals = [rand_fq2() for _ in range(len(a_vals))]
+    a, b = fq2_batch(a_vals), fq2_batch(b_vals)
+    assert fq2_out(T.fq2_mul(a, b)) == [x * y for x, y in zip(a_vals, b_vals)]
+    assert fq2_out(T.fq2_sqr(a)) == [x.square() for x in a_vals]
+    assert fq2_out(T.fq2_add(a, b)) == [x + y for x, y in zip(a_vals, b_vals)]
+    assert fq2_out(T.fq2_sub(a, b)) == [x - y for x, y in zip(a_vals, b_vals)]
+    assert fq2_out(T.fq2_conj(a)) == [x.conj() for x in a_vals]
+    assert fq2_out(T.fq2_mul_xi(a)) == [x * gt.XI for x in a_vals]
+
+
+def test_fq2_inv():
+    vals = [gt.FQ2_ONE, gt.Fq2(0, 1)] + [rand_fq2() for _ in range(3)]
+    assert fq2_out(T.fq2_inv(fq2_batch(vals))) == [v.inv() for v in vals]
+
+
+def fq6_batch(vals):
+    return np.stack([T.fq6_to_limbs(v) for v in vals])
+
+
+def fq6_out(arr):
+    arr = np.asarray(arr)
+    return [T.fq6_from_limbs(arr[i]) for i in range(arr.shape[0])]
+
+
+def test_fq6_ops():
+    a_vals = [gt.FQ6_ONE] + [rand_fq6() for _ in range(3)]
+    b_vals = [rand_fq6() for _ in range(len(a_vals))]
+    a, b = fq6_batch(a_vals), fq6_batch(b_vals)
+    assert fq6_out(T.fq6_mul(a, b)) == [x * y for x, y in zip(a_vals, b_vals)]
+    assert fq6_out(T.fq6_mul_by_v(a)) == [x.mul_by_v() for x in a_vals]
+    assert fq6_out(T.fq6_inv(fq6_batch(b_vals))) == [v.inv() for v in b_vals]
+
+
+def fq12_batch(vals):
+    return np.stack([T.fq12_to_limbs(v) for v in vals])
+
+
+def fq12_out(arr):
+    arr = np.asarray(arr)
+    return [T.fq12_from_limbs(arr[i]) for i in range(arr.shape[0])]
+
+
+def test_fq12_ops():
+    a_vals = [gt.FQ12_ONE, gt.FQ12_W] + [rand_fq12() for _ in range(3)]
+    b_vals = [rand_fq12() for _ in range(len(a_vals))]
+    a, b = fq12_batch(a_vals), fq12_batch(b_vals)
+    assert fq12_out(T.fq12_mul(a, b)) == [x * y for x, y in zip(a_vals, b_vals)]
+    assert fq12_out(T.fq12_conj(a)) == [x.conj() for x in a_vals]
+    assert fq12_out(T.fq12_inv(fq12_batch(b_vals))) == [v.inv() for v in b_vals]
+
+
+@pytest.mark.parametrize("k", [1, 2, 3])
+def test_fq12_frobenius(k):
+    """fq12_frobenius(x, k) == x^(q^k) — the bug ADVICE r1 found trips here."""
+    vals = [gt.FQ12_W, rand_fq12()]
+    out = fq12_out(T.fq12_frobenius(fq12_batch(vals), k))
+    assert out == [v ** (gt.q ** k) for v in vals]
