@@ -1,24 +1,47 @@
-"""Batched BLS12-381 base-field arithmetic in JAX: Montgomery form, 29-bit limbs.
+"""Batched BLS12-381 base-field arithmetic in JAX: Montgomery form, lazy
+signed 29-bit limbs.
 
 The reference delegates all field math to pure-Python bignums (py_ecc there,
 crypto/bls12_381.py here — /root/reference specs/bls_signature.md:96-146 for
 the contract). On TPU there is no wide multiplier, so an Fq element is a
-`[..., 14]` uint64 array of 29-bit limbs (14×29 = 406 ≥ 381 bits): limb
-products are ≤ 2^58, so a full 27-column schoolbook accumulation (≤ 14 terms
-per column, < 2^62) and the interleaved Montgomery reduction both fit uint64
-lanes with headroom. The batch dimensions are where the VPU parallelism is —
-every function is elementwise over leading axes and jit-composable.
+`[..., 14]` int64 array of 29-bit limbs (14x29 = 406 >= 381 bits).
 
-Values are kept in Montgomery form (aR mod q, R = 2^406) everywhere on
-device; conversion happens at the host boundary only. All inputs/outputs are
-normalized: limbs < 2^29, value < q.
+Design (second iteration — the first used uint64 limbs with serial per-op
+carry chains, which made every add/sub a ~130-HLO graph and blew XLA
+compile time superlinearly once thousands of ops composed into a pairing):
 
-No data-dependent control flow: fixed-length carry chains, compare-select
-conditional subtracts, fori_loop exponentiation over static bit arrays.
+- **Lazy signed limbs.** add/sub/neg are single vector ops; limbs drift out
+  of [0, 2^29) and may go negative between multiplications. Only `fq_mul`
+  and the boundary ops re-normalize.
+- **Montgomery absorbs laziness.** `fq_mul` accepts any inputs whose limbs
+  fit ~2^32 and whose VALUES satisfy |v_a|*|v_b| < q*R (true for sums of up
+  to ~2^10 field-bounded terms); its output value is in (-2q, 2q). So
+  lazily-accumulated values flow straight into the next multiply with no
+  conditional subtracts anywhere.
+- **Vectorized carry rounds.** Normalization is rounds of
+  (lo = v & MASK, hi = v >> B arithmetic, v = lo + shift_up(hi)) — whole-
+  vector ops. Three rounds crush magnitudes to limbs in [-1, 2^29]; exact
+  ripple (a borrow/carry travels one limb per round) needs L+3 rounds and
+  is reserved for the boundary ops (`fq_canon`, `fq_is_zero`, `fq_eq`),
+  where the unique signed-top representation makes sign and equality
+  testable.
+- **One schoolbook = one matmul.** The 28 column sums are an einsum of the
+  [L, L] outer product against a static one-hot [L, L, 2L] tensor — 3 HLO
+  ops instead of 14 shifted concatenations, and a shape XLA can tile.
+
+Every function is elementwise over leading batch axes; stacking independent
+multiplications along a batch axis (see fq_tower's bilinear fq12 product)
+is the intended usage pattern — it keeps both the traced graph and the
+device dispatch count flat: the graph is the same size for a batch of 2 and
+a batch of 10^6.
+
+Laziness budget (enforced by usage convention, asserted in tests):
+inputs to fq_mul must be sums/differences of at most ~2^10 Montgomery
+outputs (values < 2^10 * 2q < 2^393, limbs < 2^33 lazily or [-1, 2^29]
+after fq_norm). Tower code keeps well under this (<= 32 terms).
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Sequence
 
 import numpy as np
@@ -40,23 +63,43 @@ R_MONT = (1 << (B * L)) % Q
 R2_MONT = (R_MONT * R_MONT) % Q
 QINV_NEG = pow(-Q, -1, 1 << B)   # -q^{-1} mod 2^B (Montgomery constant)
 
+NORM_FULL = L + 3           # rounds for exact ripple propagation
+
 
 def int_to_limbs(x: int) -> np.ndarray:
-    """Host: python int -> [L] uint64 limb array (little-endian, 29-bit)."""
-    out = np.zeros(L, dtype=np.uint64)
+    """Host: python int (>= 0, < 2^406) -> [L] int64 limb array."""
+    out = np.zeros(L, dtype=np.int64)
     for i in range(L):
         out[i] = (x >> (B * i)) & MASK
     return out
 
 
 def limbs_to_int(limbs) -> int:
-    """Host: [L] limb array -> python int."""
-    arr = np.asarray(limbs, dtype=np.uint64)
-    return sum(int(arr[..., i]) << (B * i) for i in range(L))
+    """Host: [L] limb array (possibly lazy/signed) -> python int mod q."""
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(arr[..., i]) << (B * i) for i in range(L)) % Q
 
 
 Q_LIMBS = int_to_limbs(Q)
-_Q_CONST = tuple(int(v) for v in Q_LIMBS)
+_Q_NP = np.asarray(Q_LIMBS, dtype=np.int64)
+_Q2_NP = int_to_limbs(2 * Q)     # 2q < 2^383: fits 14 limbs
+
+
+def _signed_rep(x: int) -> np.ndarray:
+    """Host: the unique limb rep with limbs 0..L-2 in [0, 2^29) and the sign
+    carried by the top limb — what NORM_FULL carry rounds converge to."""
+    out = np.zeros(L, dtype=np.int64)
+    for i in range(L - 1):
+        li = x & MASK
+        out[i] = li
+        x = (x - li) >> B
+    out[L - 1] = x
+    return out
+
+
+_ZERO_PAT = np.zeros(L, dtype=np.int64)
+_Q_PAT = _signed_rep(Q)
+_NEGQ_PAT = _signed_rep(-Q)
 
 
 def to_mont(x: int) -> np.ndarray:
@@ -65,7 +108,7 @@ def to_mont(x: int) -> np.ndarray:
 
 
 def from_mont(limbs) -> int:
-    """Host: Montgomery-form limb array -> canonical int."""
+    """Host: Montgomery-form limb array (lazy ok) -> canonical int."""
     return limbs_to_int(limbs) * pow(R_MONT, -1, Q) % Q
 
 
@@ -75,144 +118,45 @@ def stack_mont(values: Sequence[int]) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# Normalization / comparison primitives (device)
+# Normalization (device)
 # ---------------------------------------------------------------------------
 
-def _carry_norm(t):
-    """Propagate carries left-to-right; limbs end < 2^B. Input limbs < 2^63."""
-    out = []
-    carry = jnp.zeros_like(t[..., 0])
-    for i in range(t.shape[-1]):
-        v = t[..., i] + carry
-        out.append(v & jnp.uint64(MASK))
-        carry = v >> jnp.uint64(B)
-    return jnp.stack(out, axis=-1), carry
+def _carry_rounds(t, n: int):
+    """n rounds of vectorized carry/borrow propagation (value-preserving:
+    the top limb keeps its own overflow in place, so values up to int64
+    range at the top limb survive; callers keep |value| < ~2^395)."""
+    for _ in range(n):
+        lo = t & MASK
+        hi = t >> B          # arithmetic shift: borrows propagate as -1
+        top = hi[..., -1]
+        up = jnp.concatenate(
+            [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1)
+        t = lo + up
+        t = t.at[..., -1].add(top << B)
+    return t
 
 
-def _geq(a, b_const):
-    """a >= b for normalized limbs vs a static limb tuple, lexicographic."""
-    gt_any = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
-    lt_any = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
-    for i in reversed(range(L)):  # most significant limb first
-        bi = jnp.uint64(b_const[i])
-        undecided = ~gt_any & ~lt_any
-        gt_any = gt_any | ((a[..., i] > bi) & undecided)
-        lt_any = lt_any | ((a[..., i] < bi) & undecided)
-    return ~lt_any  # gt_any or all-equal
-
-
-def _sub_const(a, b_const):
-    """a - b_const for normalized a >= b_const (borrow chain)."""
-    out = []
-    borrow = jnp.zeros_like(a[..., 0])
-    for i in range(L):
-        v = a[..., i] + jnp.uint64((1 << B)) - jnp.uint64(b_const[i]) - borrow
-        out.append(v & jnp.uint64(MASK))
-        borrow = jnp.uint64(1) - (v >> jnp.uint64(B))
-    return jnp.stack(out, axis=-1)
-
-
-def _cond_sub_q(a):
-    """a mod q for a < 2q (normalized limbs)."""
-    need = _geq(a, _Q_CONST)
-    sub = _sub_const(a, _Q_CONST)
-    return jnp.where(need[..., None], sub, a)
+def fq_norm(a, rounds: int = 3):
+    """Crush limb magnitudes: 3 rounds bring |limb| <= 2^33 inputs into
+    [-1, 2^29] (a stable lazy form — products still fit int64 columns).
+    Use NORM_FULL rounds for the unique signed-top representation."""
+    return _carry_rounds(a, rounds)
 
 
 # ---------------------------------------------------------------------------
-# Field ops (device; inputs normalized & < q, Montgomery form where relevant)
+# Lazy arithmetic (device) — single-op add/sub/neg
 # ---------------------------------------------------------------------------
 
 def fq_add(a, b):
-    t, _ = _carry_norm(a + b)
-    return _cond_sub_q(t)
-
-
-def _sub_arr(a, b):
-    """a - b for normalized limbs with value(a) >= value(b); borrow chain."""
-    out = []
-    borrow = jnp.zeros_like(a[..., 0])
-    for i in range(a.shape[-1]):
-        v = a[..., i] + jnp.uint64(1 << B) - b[..., i] - borrow
-        out.append(v & jnp.uint64(MASK))
-        borrow = jnp.uint64(1) - (v >> jnp.uint64(B))
-    return jnp.stack(out, axis=-1)
-
-
-_Q_NP = np.asarray(Q_LIMBS, dtype=np.uint64)  # numpy: no device array at import
-
-
-def _q_arr():
-    # jnp.asarray of a numpy constant inside a trace embeds it as a constant;
-    # caching a jnp array would leak tracers across jit boundaries.
-    return jnp.asarray(_Q_NP)
+    return a + b
 
 
 def fq_sub(a, b):
-    # (a + q) - b: a+q normalizes to < 2q which still fits 14 limbs (2q < 2^383)
-    s, _ = _carry_norm(a + _q_arr())
-    t = _sub_arr(s, b)
-    return _cond_sub_q(t)
+    return a - b
 
 
 def fq_neg(a):
-    # q - a, folded back to [0, q) (maps 0 -> q -> 0 via the conditional sub)
-    t = _sub_arr(jnp.broadcast_to(_q_arr(), a.shape), a)
-    return _cond_sub_q(t)
-
-
-# Static shifted copies of q's limbs (limb 0 dropped — it is folded into the
-# running carry): row i holds q[1..13] placed at columns i+1..i+13 of a 2L grid.
-_Q_SHIFTS = np.zeros((L, 2 * L), dtype=np.uint64)
-for _i in range(L):
-    _Q_SHIFTS[_i, _i + 1:_i + L] = np.asarray(Q_LIMBS[1:], dtype=np.uint64)
-
-
-def fq_mul(a, b):
-    """Montgomery product: a*b*R^-1 mod q. a, b normalized < q.
-
-    Column bound: schoolbook columns < 14·2^58, plus ≤14 reduction terms
-    ≤ 2^62.7 — inside uint64. Result < 2q, folded by one conditional subtract.
-
-    Compile-friendliness matters as much as runtime here: every step is a
-    whole-[2L]-vector op (shifted adds against static masks, no per-limb
-    scatter), so one fq_mul is ~200 HLO ops. Tower multiplications stack all
-    their Karatsuba leaf products into a single fq_mul call, so even an Fq12
-    product costs one instance of this graph.
-    """
-    shape = jnp.broadcast_shapes(a.shape, b.shape)
-    a = jnp.broadcast_to(a, shape)
-    b = jnp.broadcast_to(b, shape)
-    batch = shape[:-1]
-    # Phase 1: 28 column sums of the schoolbook product via shifted adds
-    zero_l = jnp.zeros(batch + (L,), dtype=jnp.uint64)
-    b_pad = jnp.concatenate([b, zero_l], axis=-1)           # [..., 2L]
-    cols = jnp.zeros(batch + (2 * L,), dtype=jnp.uint64)
-    for i in range(L):
-        shifted = jnp.concatenate(
-            [jnp.zeros(batch + (i,), dtype=jnp.uint64), b,
-             jnp.zeros(batch + (L - i,), dtype=jnp.uint64)], axis=-1)
-        cols = cols + a[..., i:i + 1] * shifted
-    del b_pad
-    # Phase 2: interleaved Montgomery reduction with a running carry;
-    # the m*q additions use static pre-shifted copies of q's limbs.
-    carry = jnp.zeros(batch, dtype=jnp.uint64)
-    qinv = jnp.uint64(QINV_NEG)
-    mask = jnp.uint64(MASK)
-    for i in range(L):
-        v = cols[..., i] + carry
-        m = (v & mask) * qinv & mask
-        # v + m*q0 is divisible by 2^B; fold its carry forward
-        carry = (v + m * jnp.uint64(_Q_CONST[0])) >> jnp.uint64(B)
-        cols = cols + m[..., None] * jnp.asarray(_Q_SHIFTS[i])
-    # Upper half + final carry propagation (no carry out: value < 2q < 2^406)
-    upper = cols[..., L:].at[..., 0].add(carry)
-    t, _top = _carry_norm(upper)
-    return _cond_sub_q(t)
-
-
-def fq_sqr(a):
-    return fq_mul(a, a)
+    return -a
 
 
 def fq_select(cond, a, b):
@@ -220,16 +164,8 @@ def fq_select(cond, a, b):
     return jnp.where(cond[..., None], a, b)
 
 
-def fq_is_zero(a):
-    return jnp.all(a == 0, axis=-1)
-
-
-def fq_eq(a, b):
-    return jnp.all(a == b, axis=-1)
-
-
 def fq_zeros(shape=()):
-    return jnp.zeros(tuple(shape) + (L,), dtype=jnp.uint64)
+    return jnp.zeros(tuple(shape) + (L,), dtype=jnp.int64)
 
 
 def fq_ones(shape=()):
@@ -237,6 +173,101 @@ def fq_ones(shape=()):
     one = jnp.asarray(to_mont(1))
     return jnp.broadcast_to(one, tuple(shape) + (L,))
 
+
+# ---------------------------------------------------------------------------
+# Multiplication (device)
+# ---------------------------------------------------------------------------
+
+# one-hot [L, L, 2L]: column k collects a_i * b_j with i + j = k
+_CONV = np.zeros((L, L, 2 * L), dtype=np.int64)
+for _i in range(L):
+    for _j in range(L):
+        _CONV[_i, _j, _i + _j] = 1
+
+# static pre-shifted copies of q's limbs 1..L-1 for the interleaved
+# reduction (limb 0 is folded into the running carry): row i holds q[1..13]
+# at columns i+1..i+13
+_Q_SHIFTS = np.zeros((L, 2 * L), dtype=np.int64)
+for _i in range(L):
+    _Q_SHIFTS[_i, _i + 1:_i + L] = _Q_NP[1:]
+
+
+def fq_mul(a, b):
+    """Montgomery product a*b*R^-1 mod q — LAZY in and out.
+
+    Inputs: limbs |l| < ~2^32 (three defensive carry rounds bring them to
+    [-1, 2^29]), values |v_a|*|v_b| < q*R (see module docstring). Output:
+    limbs in [-1, 2^29], value in (-2q, 2q). No conditional subtracts.
+
+    Trace size is what makes the pairing compile: the schoolbook is ONE
+    einsum against a static one-hot, and the 14-step interleaved reduction
+    is unrolled at ~8 ops per step. Batch leading axes aggressively."""
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+    a = _carry_rounds(a, 3)
+    b = _carry_rounds(b, 3)
+    # schoolbook: cols[k] = sum_{i+j=k} a_i b_j  (|col| <= 14*2^58 < 2^63)
+    outer = a[..., :, None] * b[..., None, :]
+    cols = jnp.einsum("...ij,ijk->...k", outer, jnp.asarray(_CONV))
+    # interleaved Montgomery reduction (m and the carry are sign-correct:
+    # & MASK works on two's complement, >> is arithmetic = exact floor
+    # division since v + m*q0 is divisible by 2^B)
+    carry = jnp.zeros(shape[:-1], dtype=jnp.int64)
+    qinv = jnp.int64(QINV_NEG)
+    mask = jnp.int64(MASK)
+    q0 = jnp.int64(int(_Q_NP[0]))
+    for i in range(L):
+        v = cols[..., i] + carry
+        m = ((v & mask) * qinv) & mask
+        carry = (v + m * q0) >> B
+        cols = cols + m[..., None] * jnp.asarray(_Q_SHIFTS[i])
+    upper = cols[..., L:].at[..., 0].add(carry)
+    return _carry_rounds(upper, 3)
+
+
+def fq_sqr(a):
+    return fq_mul(a, a)
+
+
+# ---------------------------------------------------------------------------
+# Boundary ops: canonicalization, equality (device)
+# ---------------------------------------------------------------------------
+
+def _reduce_range(a):
+    """a (any lazy value within budget) -> value-equivalent limbs with value
+    in (-2q, 2q): one Montgomery multiply by R (= to_mont(1)), which maps
+    x -> x * R * R^-1 = x mod q without leaving the Montgomery domain."""
+    return fq_mul(a, fq_ones(a.shape[:-1]))
+
+
+def fq_is_zero(a):
+    y = _carry_rounds(_reduce_range(a), NORM_FULL)
+
+    def match(pat):
+        return jnp.all(y == jnp.asarray(pat), axis=-1)
+
+    # value in (-2q, 2q) and ≡ 0 mod q  <=>  value in {-q, 0, q}
+    return match(_ZERO_PAT) | match(_Q_PAT) | match(_NEGQ_PAT)
+
+
+def fq_eq(a, b):
+    return fq_is_zero(a - b)
+
+
+def fq_canon(a):
+    """Unique canonical limbs in [0, q) (for compression/host/hashing)."""
+    t = _carry_rounds(_reduce_range(a), NORM_FULL)   # value in (-2q, 2q)
+    neg = t[..., -1] < 0
+    t = jnp.where(neg[..., None], t + jnp.asarray(_Q2_NP), t)  # -> [0, 2q)
+    t = _carry_rounds(t, NORM_FULL)
+    d = _carry_rounds(t - jnp.asarray(_Q_NP), NORM_FULL)
+    return jnp.where((d[..., -1] >= 0)[..., None], d, t)
+
+
+# ---------------------------------------------------------------------------
+# Exponentiation: inversion, square roots (device)
+# ---------------------------------------------------------------------------
 
 def _exp_bits(e: int) -> np.ndarray:
     """Static exponent -> bit array (MSB first) for fori_loop exponentiation."""
@@ -249,9 +280,10 @@ _SQRT_EXP_BITS = _exp_bits((Q + 1) // 4)
 
 
 def _fq_pow_static(a, bits_np: np.ndarray):
-    """a^e with e given as a static bit array; fori over bits, cond multiply."""
+    """a^e with e given as a static bit array; fori over bits, select-mul."""
     bits = jnp.asarray(bits_np.astype(np.uint8))
     n = int(bits_np.shape[0])
+    a = fq_norm(a)
 
     def body(i, acc):
         acc = fq_mul(acc, acc)
@@ -267,9 +299,8 @@ def fq_inv(a):
 
 
 def fq_sqrt_candidate(a):
-    """a^((q+1)/4): THE square root if a is a QR (q ≡ 3 mod 4); else garbage.
+    """a^((q+1)/4): THE square root if a is a QR (q = 3 mod 4); else garbage.
 
     Caller must check candidate^2 == a (reference decompress_g1,
-    crypto/bls12_381.py:361-378 does the same check).
-    """
+    crypto/bls12_381.py:361-378 does the same check)."""
     return _fq_pow_static(a, _SQRT_EXP_BITS)

@@ -1,7 +1,8 @@
 """Batched Fq2/Fq6/Fq12 tower arithmetic in JAX (BLS12-381 pairing support).
 
 Mirrors the ground-truth tower in crypto/bls12_381.py (same Karatsuba
-structure, same reduction constants) over limb arrays:
+structure, same reduction constants) over lazy signed limb arrays
+(see ops/fq.py for the laziness contract):
 
     Fq2  = Fq[u]/(u^2+1)        -> [..., 2, L]
     Fq6  = Fq2[v]/(v^3 - (1+u)) -> [..., 3, 2, L]
@@ -10,9 +11,17 @@ structure, same reduction constants) over limb arrays:
 plus Frobenius maps f -> f^(q^k) via host-precomputed coefficient tables
 (basis element v^i w^j = w^(2i+j) picks up xi^((q^k-1)(2i+j)/6)).
 
-All ops are elementwise over leading batch axes, Montgomery form throughout.
+Compile-time/dispatch discipline: a multiplication at any tower level costs
+exactly ONE `fq_mul` instance. fq2_mul stacks its 3 Karatsuba leaves on a
+new axis; fq12_mul is a bilinear algorithm — its 54 Fq leaf products are
+one [..., 54, L] fq_mul between einsum-applied coefficient tables (alpha,
+beta: the {0,1} pre-sum matrices; gamma: the signed post-combination
+matrix), all derived at import time by running the tower's Karatsuba
+structure symbolically. Additions/subtractions are lazy single ops.
 """
 from __future__ import annotations
+
+from typing import Dict, List, Tuple
 
 import numpy as np
 
@@ -62,64 +71,66 @@ def fq2(c0, c1):
 
 
 def fq2_add(a, b):
-    return fq2(F.fq_add(a[..., 0, :], b[..., 0, :]), F.fq_add(a[..., 1, :], b[..., 1, :]))
+    return a + b
 
 
 def fq2_sub(a, b):
-    return fq2(F.fq_sub(a[..., 0, :], b[..., 0, :]), F.fq_sub(a[..., 1, :], b[..., 1, :]))
+    return a - b
 
 
 def fq2_neg(a):
-    return fq2(F.fq_neg(a[..., 0, :]), F.fq_neg(a[..., 1, :]))
+    return -a
 
 
 def fq2_conj(a):
-    return fq2(a[..., 0, :], F.fq_neg(a[..., 1, :]))
+    return jnp.concatenate([a[..., 0:1, :], -a[..., 1:2, :]], axis=-2)
 
 
 def fq2_mul(a, b):
-    # (a0 + a1 u)(b0 + b1 u) = a0b0 - a1b1 + ((a0+a1)(b0+b1) - a0b0 - a1b1) u
+    """(a0 + a1 u)(b0 + b1 u) — Karatsuba, ONE stacked fq_mul of 3 leaves."""
     a0, a1 = a[..., 0, :], a[..., 1, :]
     b0, b1 = b[..., 0, :], b[..., 1, :]
-    t0 = F.fq_mul(a0, b0)
-    t1 = F.fq_mul(a1, b1)
-    t2 = F.fq_mul(F.fq_add(a0, a1), F.fq_add(b0, b1))
-    return fq2(F.fq_sub(t0, t1), F.fq_sub(t2, F.fq_add(t0, t1)))
+    A = jnp.stack([a0, a1, a0 + a1], axis=-2)
+    Bv = jnp.stack([b0, b1, b0 + b1], axis=-2)
+    P = F.fq_mul(A, Bv)
+    t0, t1, t2 = P[..., 0, :], P[..., 1, :], P[..., 2, :]
+    return fq2(t0 - t1, t2 - t0 - t1)
 
 
 def fq2_sqr(a):
-    # (a + bu)^2 = (a+b)(a-b) + 2ab u
+    """(a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u — one stacked fq_mul."""
     a0, a1 = a[..., 0, :], a[..., 1, :]
-    return fq2(
-        F.fq_mul(F.fq_add(a0, a1), F.fq_sub(a0, a1)),
-        F.fq_mul(F.fq_add(a0, a0), a1),
-    )
+    A = jnp.stack([a0 + a1, a0], axis=-2)
+    Bv = jnp.stack([a0 - a1, a1], axis=-2)
+    P = F.fq_mul(A, Bv)
+    return fq2(P[..., 0, :], P[..., 1, :] + P[..., 1, :])
 
 
 def fq2_scale(a, s):
-    """a * s with s an Fq element [..., L]."""
-    return fq2(F.fq_mul(a[..., 0, :], s), F.fq_mul(a[..., 1, :], s))
+    """a * s with s an Fq element [..., L] (broadcast over the Fq2 axis)."""
+    return F.fq_mul(a, s[..., None, :])
 
 
 def fq2_mul_xi(a):
     # (1 + u)(c0 + c1 u) = (c0 - c1) + (c0 + c1) u
     a0, a1 = a[..., 0, :], a[..., 1, :]
-    return fq2(F.fq_sub(a0, a1), F.fq_add(a0, a1))
+    return fq2(a0 - a1, a0 + a1)
 
 
 def fq2_inv(a):
     a0, a1 = a[..., 0, :], a[..., 1, :]
-    norm = F.fq_add(F.fq_mul(a0, a0), F.fq_mul(a1, a1))
-    inv_norm = F.fq_inv(norm)
-    return fq2(F.fq_mul(a0, inv_norm), F.fq_neg(F.fq_mul(a1, inv_norm)))
+    nrm = F.fq_mul(jnp.stack([a0, a1], axis=-2), jnp.stack([a0, a1], axis=-2))
+    inv_norm = F.fq_inv(nrm[..., 0, :] + nrm[..., 1, :])
+    out = F.fq_mul(jnp.stack([a0, a1], axis=-2), inv_norm[..., None, :])
+    return fq2(out[..., 0, :], -out[..., 1, :])
 
 
 def fq2_is_zero(a):
-    return F.fq_is_zero(a[..., 0, :]) & F.fq_is_zero(a[..., 1, :])
+    return jnp.all(F.fq_is_zero(a), axis=-1)
 
 
 def fq2_eq(a, b):
-    return F.fq_eq(a[..., 0, :], b[..., 0, :]) & F.fq_eq(a[..., 1, :], b[..., 1, :])
+    return jnp.all(F.fq_is_zero(a - b), axis=-1)
 
 
 def fq2_select(cond, a, b):
@@ -127,7 +138,7 @@ def fq2_select(cond, a, b):
 
 
 def fq2_zeros(shape=()):
-    return jnp.zeros(tuple(shape) + (2, F.L), dtype=jnp.uint64)
+    return jnp.zeros(tuple(shape) + (2, F.L), dtype=jnp.int64)
 
 
 def fq2_ones(shape=()):
@@ -135,7 +146,132 @@ def fq2_ones(shape=()):
 
 
 # ---------------------------------------------------------------------------
-# Fq6  [..., 3, 2, L]
+# Symbolic bilinear derivation of the tower product structure
+# ---------------------------------------------------------------------------
+# The Karatsuba structure of Fq12 = ((Fq2)^3)^2 multiplication is executed
+# once at import over symbolic linear combinations; each base-field product
+# becomes a leaf. Result: A = alpha @ a_components, B = beta @ b_components,
+# P = A * B (leafwise), c = gamma @ P — with alpha/beta in {0,1} (pre-sums
+# are additions only) and gamma small signed integers.
+
+class _Lin:
+    """Sparse integer linear combination over an index space."""
+
+    __slots__ = ("d",)
+
+    def __init__(self, d: Dict[int, int]):
+        self.d = {k: v for k, v in d.items() if v != 0}
+
+    def __add__(self, o):
+        d = dict(self.d)
+        for k, v in o.d.items():
+            d[k] = d.get(k, 0) + v
+        return _Lin(d)
+
+    def __sub__(self, o):
+        d = dict(self.d)
+        for k, v in o.d.items():
+            d[k] = d.get(k, 0) - v
+        return _Lin(d)
+
+    def __neg__(self):
+        return _Lin({k: -v for k, v in self.d.items()})
+
+
+def _derive_fq12_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    leaves: List[Tuple[Dict[int, int], Dict[int, int]]] = []
+
+    def leaf(x: _Lin, y: _Lin) -> _Lin:
+        for c in list(x.d.values()) + list(y.d.values()):
+            assert c == 1, "pre-sums must be pure additions"
+        leaves.append((x.d, y.d))
+        return _Lin({len(leaves) - 1: 1})
+
+    def mul2(a, b):  # Fq2 Karatsuba (mirrors fq2_mul)
+        a0, a1 = a
+        b0, b1 = b
+        t0 = leaf(a0, b0)
+        t1 = leaf(a1, b1)
+        t2 = leaf(a0 + a1, b0 + b1)
+        return (t0 - t1, t2 - t0 - t1)
+
+    def mul_xi(c):  # (1+u) * c
+        c0, c1 = c
+        return (c0 - c1, c0 + c1)
+
+    def add2(a, b):
+        return (a[0] + b[0], a[1] + b[1])
+
+    def sub2(a, b):
+        return (a[0] - b[0], a[1] - b[1])
+
+    def mul6(a, b):  # Fq6 Karatsuba (mirrors gt.Fq6.__mul__)
+        a0, a1, a2 = a
+        b0, b1, b2 = b
+        t0, t1, t2 = mul2(a0, b0), mul2(a1, b1), mul2(a2, b2)
+        c0 = add2(t0, mul_xi(sub2(mul2(add2(a1, a2), add2(b1, b2)), add2(t1, t2))))
+        c1 = add2(sub2(mul2(add2(a0, a1), add2(b0, b1)), add2(t0, t1)), mul_xi(t2))
+        c2 = add2(sub2(mul2(add2(a0, a2), add2(b0, b2)), add2(t0, t2)), t1)
+        return (c0, c1, c2)
+
+    def add6(a, b):
+        return tuple(add2(x, y) for x, y in zip(a, b))
+
+    def sub6(a, b):
+        return tuple(sub2(x, y) for x, y in zip(a, b))
+
+    def mul6_by_v(a):
+        return (mul_xi(a[2]), a[0], a[1])
+
+    # symbolic inputs: component index = j*6 + i*2 + h for [w j][v i][fq2 h]
+    def sym(base):
+        return tuple(
+            tuple((_Lin({base + j * 6 + i * 2 + 0: 1}),
+                   _Lin({base + j * 6 + i * 2 + 1: 1})) for i in range(3))
+            for j in range(2))
+
+    a_sym = sym(0)
+    b_sym = sym(0)
+    a0, a1 = a_sym
+    b0, b1 = b_sym
+    t0 = mul6(a0, b0)
+    t1 = mul6(a1, b1)
+    mid = sub6(mul6(add6(a0, a1), add6(b0, b1)), add6(t0, t1))
+    c_lo = add6(t0, mul6_by_v(t1))
+    out12 = []  # component order [j][i][h]
+    for six in (c_lo, mid):
+        for pair in six:
+            out12.extend(pair)
+
+    n = len(leaves)
+    alpha = np.zeros((n, 12), dtype=np.int64)
+    beta = np.zeros((n, 12), dtype=np.int64)
+    for k, (xa, xb) in enumerate(leaves):
+        for idx, c in xa.items():
+            alpha[k, idx] = c
+        for idx, c in xb.items():
+            beta[k, idx] = c
+    gamma = np.zeros((12, n), dtype=np.int64)
+    for j, lin in enumerate(out12):
+        for k, c in lin.d.items():
+            gamma[j, k] = c
+    return alpha, beta, gamma
+
+
+_ALPHA, _BETA, _GAMMA = _derive_fq12_tables()
+_N_LEAVES = _ALPHA.shape[0]
+# laziness check: pre-sum fan-in and post-combination growth must fit
+# fq_mul's budget — limbs <= 64*2^29 = 2^35 (crushed by its defensive carry
+# rounds) and values <= 64*2q < 2^388, keeping |v_a|*|v_b| < q*R = 2^787.
+# A real raise (not assert): python -O must not strip this invariant.
+if (int(np.abs(_GAMMA).sum(axis=1).max()) > 64
+        or int(_ALPHA.sum(axis=1).max()) > 8 or int(_BETA.sum(axis=1).max()) > 8):
+    raise ValueError("fq12 bilinear tables exceed the fq_mul laziness budget")
+
+
+# ---------------------------------------------------------------------------
+# Fq6  [..., 3, 2, L]  (used by the inversion chain; multiplies cost 6 leaf
+# stacks rather than one — acceptable: one fq6_inv per pairing check)
 # ---------------------------------------------------------------------------
 
 def fq6(c0, c1, c2):
@@ -147,15 +283,15 @@ def _c(a, i):
 
 
 def fq6_add(a, b):
-    return fq6(*(fq2_add(_c(a, i), _c(b, i)) for i in range(3)))
+    return a + b
 
 
 def fq6_sub(a, b):
-    return fq6(*(fq2_sub(_c(a, i), _c(b, i)) for i in range(3)))
+    return a - b
 
 
 def fq6_neg(a):
-    return fq6(*(fq2_neg(_c(a, i)) for i in range(3)))
+    return -a
 
 
 def fq6_mul(a, b):
@@ -179,7 +315,7 @@ def fq6_sqr(a):
 
 
 def fq6_scale_fq2(a, s):
-    return fq6(*(fq2_mul(_c(a, i), s) for i in range(3)))
+    return fq2_mul(a, s[..., None, :, :])
 
 
 def fq6_mul_by_v(a):
@@ -200,7 +336,7 @@ def fq6_inv(a):
 
 
 def fq6_zeros(shape=()):
-    return jnp.zeros(tuple(shape) + (3, 2, F.L), dtype=jnp.uint64)
+    return jnp.zeros(tuple(shape) + (3, 2, F.L), dtype=jnp.int64)
 
 
 def fq6_select(cond, a, b):
@@ -220,16 +356,19 @@ def _h(a, i):
 
 
 def fq12_add(a, b):
-    return fq12(fq6_add(_h(a, 0), _h(b, 0)), fq6_add(_h(a, 1), _h(b, 1)))
+    return a + b
 
 
 def fq12_mul(a, b):
-    a0, a1 = _h(a, 0), _h(a, 1)
-    b0, b1 = _h(b, 0), _h(b, 1)
-    t0 = fq6_mul(a0, b0)
-    t1 = fq6_mul(a1, b1)
-    mid = fq6_sub(fq6_mul(fq6_add(a0, a1), fq6_add(b0, b1)), fq6_add(t0, t1))
-    return fq12(fq6_add(t0, fq6_mul_by_v(t1)), mid)
+    """Bilinear bundle: all 54 Fq leaf products in ONE fq_mul call."""
+    batch = a.shape[:-4]
+    av = a.reshape(batch + (12, F.L))
+    bv = b.reshape(batch + (12, F.L))
+    A = jnp.einsum("ki,...il->...kl", jnp.asarray(_ALPHA), av)
+    Bv = jnp.einsum("ki,...il->...kl", jnp.asarray(_BETA), bv)
+    P = F.fq_mul(A, Bv)                                   # [..., 54, L]
+    cv = jnp.einsum("jk,...kl->...jl", jnp.asarray(_GAMMA), P)
+    return cv.reshape(batch + (2, 3, 2, F.L))
 
 
 def fq12_sqr(a):
@@ -237,7 +376,7 @@ def fq12_sqr(a):
 
 
 def fq12_conj(a):
-    return fq12(_h(a, 0), fq6_neg(_h(a, 1)))
+    return jnp.concatenate([a[..., 0:1, :, :, :], -a[..., 1:2, :, :, :]], axis=-4)
 
 
 def fq12_inv(a):
@@ -252,7 +391,7 @@ def fq12_select(cond, a, b):
 
 
 def fq12_eq(a, b):
-    return jnp.all(a == b, axis=(-1, -2, -3, -4))
+    return jnp.all(F.fq_is_zero(a - b), axis=(-1, -2, -3))
 
 
 def fq12_ones(shape=()):
@@ -265,14 +404,15 @@ def fq12_ones(shape=()):
 # ---------------------------------------------------------------------------
 # Basis element v^i w^j = w^(2i+j); (w^e)^(q^k) = xi^(e(q^k-1)/6) w^e, and the
 # Fq2 coefficient maps through conj() for odd k. Tables computed with the
-# ground-truth bignum tower at import (host, cheap).
+# ground-truth bignum tower at import (host, cheap). One batched fq2_mul
+# against the [2, 3, 2, L] coefficient table per application.
 
 def _frob_tables():
     tables = {}
     for k in (1, 2, 3):
-        coeffs = np.zeros((2, 3, 2, F.L), dtype=np.uint64)  # [w-deg j][v-deg i][Fq2 limbs]
-        for i in range(3):
-            for j in range(2):
+        coeffs = np.zeros((2, 3, 2, F.L), dtype=np.int64)  # [w j][v i][fq2][L]
+        for j in range(2):
+            for i in range(3):
                 e = 2 * i + j
                 gamma = gt.XI ** ((gt.q ** k - 1) * e // 6)
                 coeffs[j, i] = fq2_to_limbs(gamma)
@@ -284,14 +424,9 @@ _FROB = _frob_tables()
 
 
 def fq12_frobenius(a, k: int):
-    coeffs = _FROB[k]
-    parts = []
-    for j in range(2):       # w-degree
-        comps = []
-        for i in range(3):   # v-degree
-            c = a[..., j, i, :, :]
-            if k % 2 == 1:
-                c = fq2_conj(c)
-            comps.append(fq2_mul(c, jnp.asarray(coeffs[j, i])))
-        parts.append(fq6(*comps))
-    return fq12(*parts)
+    if k % 2 == 1:
+        # q-power conjugates each Fq2 coefficient (negate its u-component)
+        c = jnp.concatenate([a[..., 0:1, :], -a[..., 1:2, :]], axis=-2)
+    else:
+        c = a
+    return fq2_mul(c, jnp.asarray(_FROB[k]))

@@ -18,6 +18,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_num_cpu_devices", 8)
 
+# Persistent compilation cache: the BLS pairing programs take ~1 min each to
+# compile on the CPU backend; caching them across pytest processes turns
+# repeat runs into millisecond cache hits.
+os.makedirs("/tmp/cstpu-xla-cache", exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", "/tmp/cstpu-xla-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import pytest  # noqa: E402
 
 

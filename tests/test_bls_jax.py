@@ -1,0 +1,216 @@
+"""Differential tests: device BLS backend (ops/bls_jax.py) vs the bignum
+oracle (crypto/bls12_381.py).
+
+Layers, bottom up: Jacobian point ops -> scalar mul -> Miller loop + final
+exponentiation (compared to the oracle's pairing value CUBED — the device
+computes f^(3e), see ops/bls_jax.py docstring) -> the five spec-facing
+backend functions, which must be byte-identical to PythonBackend
+(/root/reference test_libs/pyspec/eth2spec/utils/bls.py:24-46 contract).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.crypto import bls12_381 as gt
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.ops import bls_jax as BJ
+from consensus_specs_tpu.ops import fq as F
+from consensus_specs_tpu.ops import fq_tower as T
+
+rng = random.Random(0x515)
+
+
+def rand_g1():
+    return gt.ec_mul(gt.G1_GEN, rng.randrange(1, gt.r))
+
+
+def rand_g2():
+    return gt.ec_mul(gt.G2_GEN, rng.randrange(1, gt.r))
+
+
+def g1_from_dev(x, y, inf):
+    if bool(np.asarray(inf)):
+        return None
+    return (F.from_mont(np.asarray(x)), F.from_mont(np.asarray(y)))
+
+
+def g2_from_dev(x, y, inf):
+    if bool(np.asarray(inf)):
+        return None
+    return (T.fq2_from_limbs(np.asarray(x)), T.fq2_from_limbs(np.asarray(y)))
+
+
+# ---------------------------------------------------------------------------
+# Point arithmetic
+# ---------------------------------------------------------------------------
+
+def _dev_g1_add(p1, p2):
+    """Host helper: affine oracle points -> device jac add -> affine."""
+    import jax
+    def lift(p):
+        if p is None:
+            return BJ.jac_infinity(BJ.G1_OPS)
+        arr = BJ.g1_to_limbs(p)
+        return (arr[0], arr[1], np.asarray(F.to_mont(1)))
+    out = BJ.jac_add(BJ.G1_OPS, lift(p1), lift(p2))
+    return g1_from_dev(*BJ.jac_to_affine(BJ.G1_OPS, out))
+
+
+def _dev_g2_add(p1, p2):
+    def lift(p):
+        if p is None:
+            return BJ.jac_infinity(BJ.G2_OPS)
+        arr = BJ.g2_to_limbs(p)
+        return (arr[0], arr[1], np.asarray(T.fq2_to_limbs(gt.FQ2_ONE)))
+    out = BJ.jac_add(BJ.G2_OPS, lift(p1), lift(p2))
+    return g2_from_dev(*BJ.jac_to_affine(BJ.G2_OPS, out))
+
+
+def test_g1_add_cases():
+    a, b = rand_g1(), rand_g1()
+    assert _dev_g1_add(a, b) == gt.ec_add(a, b)          # generic
+    assert _dev_g1_add(a, a) == gt.ec_double(a)          # P + P
+    assert _dev_g1_add(a, gt.ec_neg(a)) is None          # P + (-P)
+    assert _dev_g1_add(None, b) == b                     # O + Q
+    assert _dev_g1_add(a, None) == a                     # P + O
+    assert _dev_g1_add(None, None) is None               # O + O
+
+
+def test_g2_add_cases():
+    a, b = rand_g2(), rand_g2()
+    assert _dev_g2_add(a, b) == gt.ec_add(a, b)
+    assert _dev_g2_add(a, a) == gt.ec_double(a)
+    assert _dev_g2_add(a, gt.ec_neg(a)) is None
+    assert _dev_g2_add(None, b) == b
+
+
+@pytest.mark.parametrize("k", [1, 2, 3, 0xD201000000010000, None])
+def test_g2_scalar_mul(k):
+    if k is None:
+        k = rng.randrange(1, gt.r)
+    h = rand_g2()
+    arr = BJ.g2_to_limbs(h)
+    out = BJ._g2_scalar_mul(arr[0], arr[1], BJ._scalar_bits(k))
+    assert g2_from_dev(*out) == gt.ec_mul(h, k)
+
+
+def test_g1_scalar_mul():
+    k = rng.randrange(1, gt.r)
+    arr = BJ.g1_to_limbs(gt.G1_GEN)
+    out = BJ._g1_scalar_mul(arr[0], arr[1], BJ._scalar_bits(k))
+    assert g1_from_dev(*out) == gt.ec_mul(gt.G1_GEN, k)
+
+
+# ---------------------------------------------------------------------------
+# Pairing
+# ---------------------------------------------------------------------------
+
+def test_pairing_value_vs_oracle_cubed():
+    import jax
+    P, Q = rand_g1(), rand_g2()
+    fn = jax.jit(lambda x, y: BJ.final_exponentiation_3x(BJ.miller_loop_batch(x, y)))
+    res = fn(np.stack([BJ.g1_to_limbs(P)]), np.stack([BJ.g2_to_limbs(Q)]))
+    assert T.fq12_from_limbs(np.asarray(res)[0]) == gt.pairing(P, Q) ** 3
+
+
+def test_pairing_product_check():
+    P, Q = rand_g1(), rand_g2()
+    g2b = np.stack([BJ.g2_to_limbs(Q), BJ.g2_to_limbs(Q)])
+    good = np.stack([BJ.g1_to_limbs(P), BJ.g1_to_limbs(gt.ec_neg(P))])
+    bad = np.stack([BJ.g1_to_limbs(P), BJ.g1_to_limbs(P)])
+    assert bool(np.asarray(BJ._pairing_check_jit(good, g2b)))
+    assert not bool(np.asarray(BJ._pairing_check_jit(bad, g2b)))
+
+
+def test_pairing_bilinearity():
+    """e([2]P, Q) * e(-P, [2]Q) == 1 — exercises distinct points per slot."""
+    P, Q = rand_g1(), rand_g2()
+    g1b = np.stack([BJ.g1_to_limbs(gt.ec_mul(P, 2)),
+                    BJ.g1_to_limbs(gt.ec_neg(P))])
+    g2b = np.stack([BJ.g2_to_limbs(Q), BJ.g2_to_limbs(gt.ec_mul(Q, 2))])
+    assert bool(np.asarray(BJ._pairing_check_jit(g1b, g2b)))
+
+
+# ---------------------------------------------------------------------------
+# Backend surface: byte parity with PythonBackend
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def backends():
+    return gt.PythonBackend(), BJ.JaxBackend()
+
+
+PRIVKEYS = [1, 2, 3, 0xDEADBEEF]
+DOMAIN = 5
+
+
+def test_sign_parity(backends):
+    py, jx = backends
+    msg = b"\x42" * 32
+    for k in PRIVKEYS[:2]:
+        assert jx.sign(msg, k, DOMAIN) == py.sign(msg, k, DOMAIN)
+
+
+def test_aggregate_parity(backends):
+    py, jx = backends
+    pubs = [gt.privtopub(k) for k in PRIVKEYS]
+    assert jx.aggregate_pubkeys(pubs) == py.aggregate_pubkeys(pubs)
+    msg = b"\x33" * 32
+    sigs = [py.sign(msg, k, DOMAIN) for k in PRIVKEYS]
+    assert jx.aggregate_signatures(sigs) == py.aggregate_signatures(sigs)
+    # non-power-of-two and single-element inputs
+    assert jx.aggregate_pubkeys(pubs[:3]) == py.aggregate_pubkeys(pubs[:3])
+    assert jx.aggregate_pubkeys(pubs[:1]) == py.aggregate_pubkeys(pubs[:1])
+
+
+def test_verify_roundtrip(backends):
+    _, jx = backends
+    msg = b"\x77" * 32
+    k = 123
+    sig = jx.sign(msg, k, DOMAIN)
+    pub = gt.privtopub(k)
+    assert jx.verify(pub, msg, sig, DOMAIN)
+    assert not jx.verify(pub, b"\x78" * 32, sig, DOMAIN)      # wrong message
+    assert not jx.verify(pub, msg, sig, DOMAIN + 1)           # wrong domain
+    other = gt.privtopub(k + 1)
+    assert not jx.verify(other, msg, sig, DOMAIN)             # wrong key
+    assert not jx.verify(pub, msg, b"\x00" * 96, DOMAIN)      # garbage sig
+
+
+def test_verify_aggregate(backends):
+    py, jx = backends
+    msg = b"\x55" * 32
+    keys = PRIVKEYS[:3]
+    sigs = [py.sign(msg, k, DOMAIN) for k in keys]
+    agg_sig = py.aggregate_signatures(sigs)
+    agg_pub = py.aggregate_pubkeys([gt.privtopub(k) for k in keys])
+    assert jx.verify(agg_pub, msg, agg_sig, DOMAIN)
+    assert py.verify(agg_pub, msg, agg_sig, DOMAIN)  # oracle agrees
+
+
+def test_verify_multiple(backends):
+    py, jx = backends
+    msgs = [b"\x01" * 32, b"\x02" * 32]
+    keys = [7, 8]
+    sigs = [py.sign(m, k, DOMAIN) for m, k in zip(msgs, keys)]
+    agg = py.aggregate_signatures(sigs)
+    pubs = [gt.privtopub(k) for k in keys]
+    assert jx.verify_multiple(pubs, msgs, agg, DOMAIN)
+    assert not jx.verify_multiple(pubs, msgs[::-1], agg, DOMAIN)
+    assert not jx.verify_multiple(pubs, msgs, agg, DOMAIN + 1)
+    # length mismatch -> False (oracle behavior)
+    assert not jx.verify_multiple(pubs, msgs[:1], agg, DOMAIN)
+
+
+def test_registered_backend_switch():
+    """crypto.bls.set_backend('jax') works end to end and is restorable."""
+    msg = b"\x99" * 32
+    bls.set_backend("jax")
+    try:
+        sig = bls.bls_sign(msg, 42, DOMAIN)
+        pub = gt.privtopub(42)
+        assert bls.bls_verify(pub, msg, sig, DOMAIN)
+    finally:
+        bls.set_backend("python")
+    assert bls.bls_verify(pub, msg, sig, DOMAIN)  # python agrees on same bytes

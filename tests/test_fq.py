@@ -163,3 +163,78 @@ def test_fq12_frobenius(k):
     vals = [gt.FQ12_W, rand_fq12()]
     out = fq12_out(T.fq12_frobenius(fq12_batch(vals), k))
     assert out == [v ** (gt.q ** k) for v in vals]
+
+
+# ---------------------------------------------------------------------------
+# Boundary ops on adversarial lazy representations
+# ---------------------------------------------------------------------------
+
+def test_fq_canon_and_eq_adversarial():
+    """fq_canon/fq_is_zero/fq_eq on cascade-forcing lazy reps.
+
+    Patterns: all-MASK limbs (+1 value), exact multiples of q as lazy sums,
+    negative values, and Montgomery outputs."""
+    import numpy as np
+    one = F.fq_ones()
+    # value 2^406-1 as limbs (all MASK), canonized
+    allmask = np.full((1, F.L), F.MASK, dtype=np.int64)
+    expect = ((1 << (F.B * F.L)) - 1) % gt.q
+    assert F.limbs_to_int(np.asarray(F.fq_canon(allmask))[0]) == expect
+
+    # k*q lazy sums must be exactly zero for k in {-3..3}
+    qlimbs = np.asarray(F.int_to_limbs(gt.q))
+    for k in range(-3, 4):
+        lazy = (qlimbs * k)[None, :]
+        assert bool(np.asarray(F.fq_is_zero(lazy))[0]), f"k={k}"
+        assert F.limbs_to_int(np.asarray(F.fq_canon(lazy))[0]) == 0
+
+    # x vs x + q vs x - 2q: all fq_eq, canon identical, nonzero
+    x = rand_fq()
+    reps = np.stack([
+        np.asarray(F.int_to_limbs(x)),
+        np.asarray(F.int_to_limbs(x)) + qlimbs,
+        np.asarray(F.int_to_limbs(x)) - 2 * qlimbs,
+    ])
+    canon = np.asarray(F.fq_canon(reps))
+    for i in range(3):
+        assert F.limbs_to_int(canon[i]) == x
+        assert not bool(np.asarray(F.fq_is_zero(reps[i:i+1]))[0])
+    assert bool(np.asarray(F.fq_eq(reps[0:1], reps[1:2]))[0])
+    assert bool(np.asarray(F.fq_eq(reps[1:2], reps[2:3]))[0])
+    assert not bool(np.asarray(F.fq_eq(reps[0:1], one[None, :] * 0 + np.asarray(F.to_mont(1))))[0]) or x == 1
+
+
+def test_fq_sqr_scale_and_tower_sqr():
+    vals = [rand_fq() for _ in range(4)]
+    out = fq_out(F.fq_sqr(fq_batch(vals)))
+    assert out == [v * v % gt.q for v in vals]
+
+    a2 = [rand_fq2() for _ in range(3)]
+    s = [rand_fq() for _ in range(3)]
+    scaled = T.fq2_scale(fq2_batch(a2), fq_batch(s))
+    assert fq2_out(scaled) == [x * sv for x, sv in zip(a2, s)]
+    assert fq2_out(T.fq2_sqr(fq2_batch(a2))) == [x.square() for x in a2]
+
+    a6 = [rand_fq6() for _ in range(2)]
+    assert fq6_out(T.fq6_sqr(fq6_batch(a6))) == [x.square() for x in a6]
+    a12 = [rand_fq12() for _ in range(2)]
+    assert fq12_out(T.fq12_sqr(fq12_batch(a12))) == [x.square() for x in a12]
+
+
+def test_tower_eq_on_lazy_reps():
+    """fq2/fq12 equality must see through non-canonical representations —
+    this is the final pairing verdict path (bls_jax.pairing_product_is_one)."""
+    import numpy as np
+    qlimbs = np.asarray(F.int_to_limbs(gt.q))
+    a = rand_fq12()
+    x = T.fq12_to_limbs(a)
+    y = x + qlimbs          # every component shifted by +q: same field value
+    assert bool(np.asarray(T.fq12_eq(x[None], y[None]))[0])
+    z = np.array(y)
+    z[0, 0, 0] = z[0, 0, 0] + 1  # genuinely different value
+    assert not bool(np.asarray(T.fq12_eq(x[None], z[None]))[0])
+
+    b = rand_fq2()
+    bx = T.fq2_to_limbs(b)
+    assert bool(np.asarray(T.fq2_eq(bx[None], (bx - 3 * qlimbs)[None]))[0])
+    assert bool(np.asarray(T.fq2_is_zero((qlimbs * np.int64(2))[None, None, :].repeat(2, 1)))[0])
