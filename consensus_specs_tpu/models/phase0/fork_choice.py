@@ -1,0 +1,201 @@
+"""LMD-GHOST fork choice: Store + head selection, with a vectorized path.
+
+Capability parity with the reference's fork-choice document
+(/root/reference specs/core/0_fork-choice.md:59-105): an abstract `Store` of
+observed blocks/attestations, `get_ancestor`, and `lmd_ghost` head selection
+weighted by effective balance with ties broken by lexicographically higher
+root.
+
+TPU-first redesign (per SURVEY.md §7 step 5): instead of the reference's
+O(validators x blocks x depth) nested walk, the store flattens its block DAG
+into parent-pointer arrays. Head selection is then:
+
+  1. latest-message targets: a `[V]` int32 array of block indices + a `[V]`
+     uint64 effective-balance array -> per-block direct vote weight via one
+     scatter-add (`np.add.at` / `jnp scatter`),
+  2. subtree weights: one reverse-topological pass accumulating child weight
+     into parents (blocks are appended in topological order already — a
+     parent is always inserted before its children),
+  3. head walk: descend from the justified head picking the max
+     (subtree_weight, root) child each step.
+
+Steps 1-2 are pure array ops (the hot part at 1M validators is the
+scatter-add, which jax lowers to a single `scatter` on device); step 3 walks
+block-tree depth, which is tiny (<= a few epochs of slots). A differential
+test (tests/test_fork_choice.py) checks the vectorized head equals the
+reference-shaped object-model walk on randomized DAGs.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class LatestMessage:
+    """A validator's latest attestation vote (highest slot wins; first
+    observation wins ties — reference get_latest_attestation contract)."""
+    slot: int
+    beacon_block_root: bytes
+
+
+@dataclass
+class Store:
+    """Observed chain data, flattened for array-at-once fork choice.
+
+    Blocks must be added parent-first (the reference requires recursively
+    verified ancestors before processing a block, 0_fork-choice.md:38-41, so
+    topological insertion order is guaranteed by the protocol).
+    """
+    genesis_root: bytes = b""
+    # flattened block DAG
+    block_index: Dict[bytes, int] = field(default_factory=dict)
+    roots: List[bytes] = field(default_factory=list)
+    slots: List[int] = field(default_factory=list)
+    parents: List[int] = field(default_factory=list)     # index; -1 for genesis
+    blocks: List[object] = field(default_factory=list)   # BeaconBlock objects
+    children: List[List[int]] = field(default_factory=list)
+    # latest attestation message per validator index
+    latest_messages: Dict[int, LatestMessage] = field(default_factory=dict)
+    # justification bookkeeping (highest seen)
+    justified_root: bytes = b""
+    finalized_root: bytes = b""
+
+    # -- block/attestation intake -------------------------------------------
+
+    def add_block(self, root: bytes, block, parent_root: Optional[bytes]) -> int:
+        assert root not in self.block_index, "duplicate block"
+        if parent_root is None:
+            parent = -1
+            self.genesis_root = root
+            if not self.justified_root:
+                self.justified_root = root
+                self.finalized_root = root
+        else:
+            assert parent_root in self.block_index, "parent not processed"
+            parent = self.block_index[parent_root]
+        idx = len(self.roots)
+        self.block_index[root] = idx
+        self.roots.append(root)
+        self.slots.append(int(block.slot))
+        self.parents.append(parent)
+        self.blocks.append(block)
+        self.children.append([])
+        if parent >= 0:
+            self.children[parent].append(idx)
+        return idx
+
+    def on_attestation(self, validator_indices: Sequence[int],
+                       beacon_block_root: bytes, slot: int) -> None:
+        """Record latest messages for the attesting validators. ZERO_HASH
+        targets alias the genesis block (0_fork-choice.md:105-109)."""
+        if beacon_block_root == b"\x00" * 32:
+            beacon_block_root = self.genesis_root
+        if beacon_block_root not in self.block_index:
+            return  # unviable target: not yet observed
+        for v in validator_indices:
+            prev = self.latest_messages.get(int(v))
+            if prev is None or slot > prev.slot:
+                self.latest_messages[int(v)] = LatestMessage(
+                    slot=int(slot), beacon_block_root=beacon_block_root)
+
+    # -- reference-shaped object walk (oracle path) -------------------------
+
+    def get_parent(self, idx: int) -> int:
+        return self.parents[idx]
+
+    def get_ancestor(self, idx: int, slot: int) -> Optional[int]:
+        """Index of the ancestor of block `idx` at `slot`; None if above it.
+        Iterative (the reference's recursion, 0_fork-choice.md:61-69, is
+        depth-bounded only by chain length)."""
+        while idx >= 0:
+            if self.slots[idx] == slot:
+                return idx
+            if self.slots[idx] < slot:
+                return None
+            idx = self.parents[idx]
+        return None
+
+
+def lmd_ghost_reference(store: Store, effective_balances: Sequence[int],
+                        active_indices: Sequence[int],
+                        start_root: bytes) -> bytes:
+    """Object-model LMD-GHOST (the oracle): per-child vote counting through
+    get_ancestor, ties by lexicographically higher root
+    (0_fork-choice.md:78-103). O(V * B * depth) — test scale only."""
+    targets = [
+        (int(v), store.block_index[store.latest_messages[int(v)].beacon_block_root])
+        for v in active_indices if int(v) in store.latest_messages
+    ]
+
+    def vote_count(block_idx: int) -> int:
+        blk_slot = store.slots[block_idx]
+        return sum(
+            int(effective_balances[v])
+            for v, tgt in targets
+            if store.get_ancestor(tgt, blk_slot) == block_idx
+        )
+
+    head = store.block_index[start_root]
+    while True:
+        kids = store.children[head]
+        if not kids:
+            return store.roots[head]
+        head = max(kids, key=lambda i: (vote_count(i), store.roots[i]))
+
+
+def subtree_weights(store: Store, effective_balances: np.ndarray,
+                    active_indices: Sequence[int]) -> np.ndarray:
+    """[B] uint64 subtree vote weight per block — the vectorized core.
+
+    Direct weights by one scatter-add over latest-message targets; subtree
+    accumulation by a single reverse-topological sweep (parents precede
+    children by insertion order, so a reverse linear scan is a valid
+    reverse-topological order)."""
+    B = len(store.roots)
+    direct = np.zeros(B, dtype=np.uint64)
+    active = set(int(v) for v in active_indices)
+    tgt_idx = []
+    tgt_w = []
+    for v, msg in store.latest_messages.items():
+        if v not in active:
+            continue
+        tgt_idx.append(store.block_index[msg.beacon_block_root])
+        tgt_w.append(int(effective_balances[v]))
+    if tgt_idx:
+        np.add.at(direct, np.asarray(tgt_idx), np.asarray(tgt_w, dtype=np.uint64))
+    acc = direct.copy()
+    parents = np.asarray(store.parents)
+    for i in range(B - 1, 0, -1):
+        p = parents[i]
+        if p >= 0:
+            acc[p] += acc[i]
+    return acc
+
+
+def lmd_ghost(store: Store, effective_balances: Sequence[int],
+              active_indices: Sequence[int], start_root: bytes) -> bytes:
+    """Vectorized LMD-GHOST head selection. Same result as the reference
+    walk: a block's vote count in the reference is exactly the sum of
+    balances whose latest target lies in its subtree (get_ancestor(target,
+    block.slot) == block <=> block is an ancestor-or-self of target ON the
+    path — equivalent for tree-structured stores)."""
+    balances = np.asarray(effective_balances, dtype=np.uint64)
+    weights = subtree_weights(store, balances, active_indices)
+    head = store.block_index[start_root]
+    while True:
+        kids = store.children[head]
+        if not kids:
+            return store.roots[head]
+        head = max(kids, key=lambda i: (int(weights[i]), store.roots[i]))
+
+
+def get_head(spec, store: Store, justified_state) -> bytes:
+    """Convenience entry: head from the justified state's registry (the
+    reference's `lmd_ghost(store, justified_head_state, justified_head)`)."""
+    epoch = spec.slot_to_epoch(justified_state.slot)
+    active = spec.get_active_validator_indices(justified_state, epoch)
+    balances = [v.effective_balance for v in justified_state.validator_registry]
+    return lmd_ghost(store, balances, active, store.justified_root)

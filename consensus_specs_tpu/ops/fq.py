@@ -25,9 +25,12 @@ compile time superlinearly once thousands of ops composed into a pairing):
   is reserved for the boundary ops (`fq_canon`, `fq_is_zero`, `fq_eq`),
   where the unique signed-top representation makes sign and equality
   testable.
-- **One schoolbook = one matmul.** The 28 column sums are an einsum of the
-  [L, L] outer product against a static one-hot [L, L, 2L] tensor — 3 HLO
-  ops instead of 14 shifted concatenations, and a shape XLA can tile.
+- **No integer matmuls, ever.** The TPU v5e has no 64-bit integer dot
+  unit: XLA's X64 rewriter emulates elementwise s64 mul/add/shift but
+  rejects `s64 dot_general`. The schoolbook is therefore L statically
+  placed shifted adds of elementwise limb products (pad + add — shapes
+  static, fully fusable), and every "matrix apply" elsewhere in the BLS
+  stack (fq_tower's bilinear tables) is unrolled the same way.
 
 Every function is elementwise over leading batch axes; stacking independent
 multiplications along a batch axis (see fq_tower's bilinear fq12 product)
@@ -178,12 +181,6 @@ def fq_ones(shape=()):
 # Multiplication (device)
 # ---------------------------------------------------------------------------
 
-# one-hot [L, L, 2L]: column k collects a_i * b_j with i + j = k
-_CONV = np.zeros((L, L, 2 * L), dtype=np.int64)
-for _i in range(L):
-    for _j in range(L):
-        _CONV[_i, _j, _i + _j] = 1
-
 # static pre-shifted copies of q's limbs 1..L-1 for the interleaved
 # reduction (limb 0 is folded into the running carry): row i holds q[1..13]
 # at columns i+1..i+13
@@ -199,17 +196,22 @@ def fq_mul(a, b):
     [-1, 2^29]), values |v_a|*|v_b| < q*R (see module docstring). Output:
     limbs in [-1, 2^29], value in (-2q, 2q). No conditional subtracts.
 
-    Trace size is what makes the pairing compile: the schoolbook is ONE
-    einsum against a static one-hot, and the 14-step interleaved reduction
-    is unrolled at ~8 ops per step. Batch leading axes aggressively."""
+    TPU-legal by construction: the v5e has no 64-bit integer dot unit (the
+    X64 rewriter implements elementwise s64 mul/add/shift but rejects
+    `s64 dot_general`), so the schoolbook is L unrolled shifted adds of
+    elementwise products — never a matmul. The 14-step interleaved
+    reduction is unrolled at ~8 ops per step. Batch leading axes
+    aggressively."""
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)
     a = _carry_rounds(a, 3)
     b = _carry_rounds(b, 3)
     # schoolbook: cols[k] = sum_{i+j=k} a_i b_j  (|col| <= 14*2^58 < 2^63)
-    outer = a[..., :, None] * b[..., None, :]
-    cols = jnp.einsum("...ij,ijk->...k", outer, jnp.asarray(_CONV))
+    # as L statically-placed shifted adds of [..., L] elementwise products
+    pad = [(0, 0)] * (len(shape) - 1)
+    cols = sum(
+        jnp.pad(a[..., i:i + 1] * b, pad + [(i, L - i)]) for i in range(L))
     # interleaved Montgomery reduction (m and the carry are sign-correct:
     # & MASK works on two's complement, >> is arithmetic = exact floor
     # division since v + m*q0 is divisible by 2^B)

@@ -14,10 +14,11 @@ plus Frobenius maps f -> f^(q^k) via host-precomputed coefficient tables
 Compile-time/dispatch discipline: a multiplication at any tower level costs
 exactly ONE `fq_mul` instance. fq2_mul stacks its 3 Karatsuba leaves on a
 new axis; fq12_mul is a bilinear algorithm — its 54 Fq leaf products are
-one [..., 54, L] fq_mul between einsum-applied coefficient tables (alpha,
-beta: the {0,1} pre-sum matrices; gamma: the signed post-combination
-matrix), all derived at import time by running the tower's Karatsuba
-structure symbolically. Additions/subtractions are lazy single ops.
+one [..., 54, L] fq_mul between coefficient tables applied as trace-time
+unrolled adds (`_apply_int_matrix` — NEVER an einsum/dot_general: s64
+matmuls don't lower to the TPU; alpha/beta are the {0,1} pre-sum matrices,
+gamma the signed post-combination matrix), all derived at import time by
+running the tower's Karatsuba structure symbolically. Additions/subtractions are lazy single ops.
 """
 from __future__ import annotations
 
@@ -359,15 +360,40 @@ def fq12_add(a, b):
     return a + b
 
 
+def _apply_int_matrix(mat: np.ndarray, x):
+    """[R, C] small-int static matrix applied over x's C axis ([..., C, L])
+    as trace-time-unrolled adds — NEVER a dot_general (the TPU X64 rewriter
+    has no s64 matmul). mat entries are tiny (fan-in <= 64 by the laziness
+    budget check below), so each output row is a short sum of +/-x[c] terms
+    with an occasional small scalar multiple (elementwise s64: TPU-legal)."""
+    rows = []
+    for r in range(mat.shape[0]):
+        acc = None
+        for c in range(mat.shape[1]):
+            v = int(mat[r, c])
+            if v == 0:
+                continue
+            term = x[..., c, :]
+            if v == -1:
+                term = -term
+            elif v != 1:
+                term = term * jnp.int64(v)
+            acc = term if acc is None else acc + term
+        if acc is None:
+            acc = jnp.zeros(x.shape[:-2] + (F.L,), dtype=jnp.int64)
+        rows.append(acc)
+    return jnp.stack(rows, axis=-2)
+
+
 def fq12_mul(a, b):
     """Bilinear bundle: all 54 Fq leaf products in ONE fq_mul call."""
     batch = a.shape[:-4]
     av = a.reshape(batch + (12, F.L))
     bv = b.reshape(batch + (12, F.L))
-    A = jnp.einsum("ki,...il->...kl", jnp.asarray(_ALPHA), av)
-    Bv = jnp.einsum("ki,...il->...kl", jnp.asarray(_BETA), bv)
+    A = _apply_int_matrix(_ALPHA, av)
+    Bv = _apply_int_matrix(_BETA, bv)
     P = F.fq_mul(A, Bv)                                   # [..., 54, L]
-    cv = jnp.einsum("jk,...kl->...jl", jnp.asarray(_GAMMA), P)
+    cv = _apply_int_matrix(_GAMMA, P)
     return cv.reshape(batch + (2, 3, 2, F.L))
 
 

@@ -1,0 +1,307 @@
+"""Bulk (device-batched) hash_tree_root for the big state vectors.
+
+The recursive object-model Merkleizer (impl.hash_tree_root) walks every
+element through Python — at 1M validators that is minutes of host work for
+a root the protocol needs every slot (/root/reference
+specs/core/0_beacon-chain.md:1232-1245 hashes the full state per slot;
+Merkleization contract: specs/simple-serialize.md:139-158 and
+test_libs/pyspec/eth2spec/utils/ssz/ssz_impl.py:144-155 +
+merkle_minimal.py:47-54).
+
+This module computes the same roots from *columns*:
+
+  - a List[Container] whose fields are all fixed-size basics/BytesN becomes
+    a [V, P, 32] chunk tensor built with numpy column ops (no per-element
+    recursion), reduced level-by-level on the device — every level of every
+    element's subtree is ONE batched sha256_pairs launch over the whole
+    registry;
+  - basic lists/vectors (balances, slashed-balance tables) pack straight
+    into [C, 32] chunk matrices via dtype views;
+  - Bytes32 vectors (block/state/randao roots) are already chunk matrices.
+
+`hash_tree_root_bulk` mirrors impl.hash_tree_root's dispatch, routing any
+shape it cannot vectorize back through the recursive oracle, so it is safe
+to call on arbitrary objects and bit-identical by construction (asserted in
+tests/test_bulk_htr.py). `state_root_bulk` is the BeaconState entry point.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List as PyList, Optional, Sequence
+
+import numpy as np
+
+from ..hash import ZERO_BYTES32, zerohashes
+from . import impl
+from .typing import (
+    is_bool_type, is_bytes_type, is_bytesn_type, is_container_type,
+    is_list_kind, is_list_type, is_uint_type, is_vector_type, read_elem_type,
+    uint_byte_size)
+
+# below this many 64-byte pair inputs, OpenSSL beats device dispatch
+_DEVICE_MIN_PAIRS = 2048
+
+
+# ---------------------------------------------------------------------------
+# Array-level hashing primitives
+# ---------------------------------------------------------------------------
+
+def hash_pairs_array(pairs: np.ndarray) -> np.ndarray:
+    """[N, 64] uint8 -> [N, 32] uint8 SHA-256, device-batched when large.
+
+    Device batches are zero-padded up to the next power of two so the jit
+    cache sees log-many shapes total (a Merkle reduction otherwise presents
+    a fresh shape per level per tree size and pays a compile each)."""
+    n = pairs.shape[0]
+    if n >= _DEVICE_MIN_PAIRS:
+        import jax.numpy as jnp
+        from ...ops.sha256 import bytes_to_words, sha256_pairs, words_to_bytes
+        m = 1
+        while m < n:
+            m *= 2
+        padded = np.zeros((m, 64), dtype=np.uint8)
+        padded[:n] = pairs
+        digests = sha256_pairs(jnp.asarray(bytes_to_words(padded)))
+        return words_to_bytes(np.asarray(digests))[:n]
+    import hashlib
+    out = np.empty((n, 32), dtype=np.uint8)
+    for i in range(n):
+        out[i] = np.frombuffer(hashlib.sha256(pairs[i].tobytes()).digest(), np.uint8)
+    return out
+
+
+def _zero_chunk_rows(n: int, depth: int) -> np.ndarray:
+    row = np.frombuffer(zerohashes[depth], dtype=np.uint8)
+    return np.broadcast_to(row, (n, 32))
+
+
+def merkleize_chunk_array(chunks: np.ndarray) -> bytes:
+    """Root over an [N, 32] uint8 chunk matrix (next-pow2 zero padding),
+    identical to merkle.merkleize_chunks on the equivalent byte list."""
+    n = chunks.shape[0]
+    if n == 0:
+        return ZERO_BYTES32
+    level = np.ascontiguousarray(chunks)
+    depth = 0
+    while level.shape[0] > 1:
+        if level.shape[0] % 2 == 1:
+            level = np.concatenate([level, _zero_chunk_rows(1, depth)])
+        level = hash_pairs_array(level.reshape(-1, 64))
+        depth += 1
+    return level[0].tobytes()
+
+
+def subtree_roots_batch(leaves: np.ndarray) -> np.ndarray:
+    """[V, P, 32] uint8 (P a power of two) -> [V, 32] subtree roots.
+
+    All V subtrees descend one level per hash call: the [V, P/2, 64] tensor
+    flattens into one (V*P/2)-lane batch — the device sees registry-sized
+    batches even though each element's tree is tiny."""
+    V, P, _ = leaves.shape
+    assert P & (P - 1) == 0, "pad element chunk count to a power of two"
+    level = leaves
+    while level.shape[1] > 1:
+        level = hash_pairs_array(
+            level.reshape(-1, 64)).reshape(V, level.shape[1] // 2, 32)
+    return level[:, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# Column -> chunk builders (numpy, no per-element Python)
+# ---------------------------------------------------------------------------
+
+def uint_column_chunks(values: Sequence[int], byte_len: int) -> np.ndarray:
+    """[V] ints -> [V, 32] one-chunk-per-value little-endian leaves."""
+    v = len(values)
+    out = np.zeros((v, 32), dtype=np.uint8)
+    if byte_len <= 8:
+        col = np.asarray(values, dtype=np.uint64)
+        out[:, :8] = col.astype("<u8").view(np.uint8).reshape(v, 8)
+    else:
+        for i, x in enumerate(values):  # uint128/uint256 columns are rare
+            out[i, :byte_len] = np.frombuffer(
+                int(x).to_bytes(byte_len, "little"), np.uint8)
+    return out
+
+
+def bool_column_chunks(values: Sequence[bool]) -> np.ndarray:
+    v = len(values)
+    out = np.zeros((v, 32), dtype=np.uint8)
+    out[:, 0] = np.asarray(values, dtype=np.uint8)
+    return out
+
+
+def bytes_column_matrix(values: Sequence[bytes], length: int) -> np.ndarray:
+    """[V] equal-length byte strings -> [V, length] uint8."""
+    joined = b"".join(values)
+    return np.frombuffer(joined, dtype=np.uint8).reshape(len(values), length)
+
+
+def bytesn_column_leaves(values: Sequence[bytes], length: int) -> np.ndarray:
+    """[V] Bytes[N] values -> [V, 32] hash_tree_root leaves (pre-hashing the
+    mini-tree for N > 32 on device: Bytes48 -> 1 level, Bytes96 -> 2)."""
+    mat = bytes_column_matrix(values, length)
+    v = mat.shape[0]
+    n_chunks = (length + 31) // 32
+    if n_chunks == 1:
+        out = np.zeros((v, 32), dtype=np.uint8)
+        out[:, :length] = mat
+        return out
+    pad = 1
+    while pad < n_chunks:
+        pad *= 2
+    chunks = np.zeros((v, pad, 32), dtype=np.uint8)
+    flat = chunks.reshape(v, pad * 32)
+    flat[:, :length] = mat
+    return subtree_roots_batch(chunks)
+
+
+def pack_basic_list_chunks(values: Sequence[Any], elem_type: Any) -> np.ndarray:
+    """Pack a basic-element series into its [C, 32] chunk matrix (SSZ pack,
+    specs/simple-serialize.md:139-147)."""
+    if isinstance(values, bytes):
+        data = np.frombuffer(values, dtype=np.uint8)
+    elif is_bool_type(elem_type):
+        data = np.asarray(values, dtype=np.uint8)
+    else:
+        size = uint_byte_size(elem_type)
+        if size == 8:
+            data = np.asarray(values, dtype=np.uint64).astype("<u8").view(np.uint8)
+        else:
+            data = np.frombuffer(
+                b"".join(int(x).to_bytes(size, "little") for x in values), np.uint8)
+    n = data.shape[0]
+    c = max(1, (n + 31) // 32)
+    out = np.zeros((c, 32), dtype=np.uint8)
+    out.reshape(-1)[:n] = data
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Container-list fast path
+# ---------------------------------------------------------------------------
+
+def _is_fast_field(typ: Any) -> bool:
+    return is_uint_type(typ) or is_bool_type(typ) or is_bytesn_type(typ)
+
+
+def container_list_is_fast(elem_type: Any) -> bool:
+    return is_container_type(elem_type) and all(
+        _is_fast_field(t) for t in elem_type.get_field_types())
+
+
+def container_column_leaves(columns: Dict[str, Any], elem_type: Any,
+                            count: int) -> np.ndarray:
+    """Columns (field name -> [V] sequence) -> [V, P, 32] leaf tensor."""
+    fields = elem_type.get_fields()
+    pad = 1
+    while pad < len(fields):
+        pad *= 2
+    leaves = np.zeros((count, pad, 32), dtype=np.uint8)
+    for k, (name, ftyp) in enumerate(fields):
+        col = columns[name]
+        if is_uint_type(ftyp):
+            leaves[:, k, :] = uint_column_chunks(col, uint_byte_size(ftyp))
+        elif is_bool_type(ftyp):
+            leaves[:, k, :] = bool_column_chunks(col)
+        elif is_bytesn_type(ftyp):
+            leaves[:, k, :] = bytesn_column_leaves(col, ftyp.length)
+        else:
+            raise TypeError(f"not a fast column field: {ftyp}")
+    return leaves
+
+
+def container_list_roots(objs: Sequence[Any], elem_type: Any) -> np.ndarray:
+    """[V] container objects -> [V, 32] element hash_tree_roots (bulk)."""
+    columns = {
+        name: [getattr(o, name) for o in objs]
+        for name, _ in elem_type.get_fields()
+    }
+    leaves = container_column_leaves(columns, elem_type, len(objs))
+    return subtree_roots_batch(leaves)
+
+
+# ---------------------------------------------------------------------------
+# Generic bulk dispatcher
+# ---------------------------------------------------------------------------
+
+def hash_tree_root_bulk(obj: Any, typ: Any = None) -> bytes:
+    """Same value as impl.hash_tree_root, with device-batched fast paths for
+    big homogeneous collections. Falls back to the recursive oracle for
+    anything it can't vectorize."""
+    if typ is None:
+        return impl.hash_tree_root(obj)
+
+    if impl.is_bottom_layer_kind(typ) and not impl.is_basic_type(typ):
+        chunks = pack_basic_list_chunks(obj, read_elem_type(typ))
+        root = merkleize_chunk_array(chunks)
+        return impl.mix_in_length(root, len(obj)) if is_list_kind(typ) else root
+
+    if is_list_type(typ) or is_vector_type(typ):
+        elem = typ.elem_type
+        n = len(obj)
+        if n == 0:
+            leaves: Optional[np.ndarray] = np.zeros((0, 32), dtype=np.uint8)
+        elif container_list_is_fast(elem):
+            leaves = container_list_roots(list(obj), elem)
+        elif is_bytesn_type(elem):
+            leaves = bytesn_column_leaves([bytes(x) for x in obj], elem.length)
+        else:
+            leaves = np.stack([
+                np.frombuffer(hash_tree_root_bulk(v, elem), np.uint8)
+                for v in obj])
+        root = merkleize_chunk_array(leaves)
+        return impl.mix_in_length(root, n) if is_list_kind(typ) else root
+
+    if is_container_type(typ):
+        leaves = np.stack([
+            np.frombuffer(hash_tree_root_bulk(v, t), np.uint8)
+            for v, t in obj.get_typed_values()])
+        return merkleize_chunk_array(leaves)
+
+    return impl.hash_tree_root(obj, typ)
+
+
+def state_root_bulk(state: Any) -> bytes:
+    """BeaconState hash_tree_root via the bulk paths (registry + balances +
+    root vectors dominate; everything else is tiny)."""
+    return hash_tree_root_bulk(state, state.__class__)
+
+
+# ---------------------------------------------------------------------------
+# SoA direct path (no object extraction at all — bench/production shape)
+# ---------------------------------------------------------------------------
+
+def validator_registry_root_from_columns(
+        pubkeys: np.ndarray, withdrawal_credentials: np.ndarray,
+        activation_eligibility_epoch: np.ndarray, activation_epoch: np.ndarray,
+        exit_epoch: np.ndarray, withdrawable_epoch: np.ndarray,
+        slashed: np.ndarray, effective_balance: np.ndarray) -> bytes:
+    """List[Validator] root straight from SoA arrays (pubkeys [V,48] uint8,
+    withdrawal_credentials [V,32] uint8, epochs/balances [V] uint64,
+    slashed [V] bool) — zero per-validator Python. Field order matches
+    containers.Validator (spec: 0_beacon-chain.md:278-298)."""
+    V = pubkeys.shape[0]
+    leaves = np.zeros((V, 8, 32), dtype=np.uint8)
+    pk = np.zeros((V, 2, 32), dtype=np.uint8)
+    pk.reshape(V, 64)[:, :48] = pubkeys
+    leaves[:, 0, :] = subtree_roots_batch(pk)
+    leaves[:, 1, :] = withdrawal_credentials
+    for k, col in ((2, activation_eligibility_epoch), (3, activation_epoch),
+                   (4, exit_epoch), (5, withdrawable_epoch)):
+        leaves[:, k, :8] = np.asarray(col, dtype=np.uint64).astype(
+            "<u8").view(np.uint8).reshape(V, 8)
+    leaves[:, 6, 0] = np.asarray(slashed, dtype=np.uint8)
+    leaves[:, 7, :8] = np.asarray(effective_balance, dtype=np.uint64).astype(
+        "<u8").view(np.uint8).reshape(V, 8)
+    roots = subtree_roots_batch(leaves)
+    return impl.mix_in_length(merkleize_chunk_array(roots), V)
+
+
+def uint64_list_root_from_column(values: np.ndarray) -> bytes:
+    """List[uint64] root straight from a [V] uint64 array (balances)."""
+    v = np.asarray(values, dtype=np.uint64)
+    n = v.shape[0]
+    c = max(1, (n * 8 + 31) // 32)
+    out = np.zeros((c, 32), dtype=np.uint8)
+    out.reshape(-1)[:n * 8] = v.astype("<u8").view(np.uint8)
+    return impl.mix_in_length(merkleize_chunk_array(out), n)

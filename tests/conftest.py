@@ -6,17 +6,20 @@ virtual devices per the multi-chip test strategy.
 """
 import os
 
-# Force CPU: the ambient environment points JAX_PLATFORMS at the TPU relay,
-# but the test suite is defined to run on a virtual 8-device CPU mesh
-# (bench.py is the TPU consumer). setdefault is not enough — override.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Force CPU: the ambient environment points JAX at the TPU relay, and the
+# site hook pre-imports jax — so mutating os.environ["JAX_PLATFORMS"] here
+# is too late (jax read the env var at import). The robust pin is the
+# config API, which works any time before backend initialization. The test
+# suite is defined to run on a virtual 8-device CPU mesh (bench.py and the
+# opt-in CSTPU_TEST_TPU=1 mode are the real-TPU consumers).
+if os.environ.get("CSTPU_TEST_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"  # belt: covers a not-yet-imported jax
 
-# jax >= 0.9: the old XLA_FLAGS --xla_force_host_platform_device_count is a
-# no-op; the supported way to get virtual CPU devices is the config flag,
-# set before the backend initializes (i.e. before any test imports jax).
 import jax  # noqa: E402
 
-jax.config.update("jax_num_cpu_devices", 8)
+if os.environ.get("CSTPU_TEST_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")  # suspenders: post-import pin
+    jax.config.update("jax_num_cpu_devices", 8)
 
 # Persistent compilation cache: the BLS pairing programs take ~1 min each to
 # compile on the CPU backend; caching them across pytest processes turns

@@ -1,0 +1,126 @@
+"""Fork choice: vectorized LMD-GHOST vs the reference-shaped object walk.
+
+Covers the contract of /root/reference specs/core/0_fork-choice.md:59-105:
+ancestor lookup, effective-balance-weighted vote counting, head selection,
+tie-breaking by lexicographically higher root, and the genesis aliasing of
+ZERO_HASH attestation targets (:105-109).
+"""
+import random
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.models.phase0.fork_choice import (
+    Store, lmd_ghost, lmd_ghost_reference, subtree_weights)
+
+
+def _blk(slot):
+    return SimpleNamespace(slot=slot)
+
+
+def _root(i):
+    return bytes([i]) + bytes(31)
+
+
+def build_random_store(rng, n_blocks=40):
+    """Random tree with strictly increasing slots along every branch."""
+    store = Store()
+    store.add_block(_root(0), _blk(0), None)
+    for i in range(1, n_blocks):
+        parent = rng.randrange(i)
+        slot = store.slots[parent] + rng.randrange(1, 4)
+        store.add_block(_root(i), _blk(slot), store.roots[parent])
+    return store
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_vectorized_matches_reference_walk(seed):
+    rng = random.Random(seed)
+    store = build_random_store(rng)
+    V = 50
+    balances = [32_000_000_000 + rng.randrange(10 ** 9) for _ in range(V)]
+    for v in range(V):
+        tgt = rng.randrange(len(store.roots))
+        store.on_attestation([v], store.roots[tgt], slot=store.slots[tgt])
+    active = list(range(V))
+    got = lmd_ghost(store, balances, active, store.roots[0])
+    want = lmd_ghost_reference(store, balances, active, store.roots[0])
+    assert got == want
+
+
+def test_tie_broken_by_higher_root():
+    store = Store()
+    store.add_block(_root(0), _blk(0), None)
+    store.add_block(_root(1), _blk(1), _root(0))   # child A
+    store.add_block(_root(2), _blk(1), _root(0))   # child B: higher root
+    balances = [1, 1]
+    store.on_attestation([0], _root(1), slot=1)
+    store.on_attestation([1], _root(2), slot=1)
+    head = lmd_ghost(store, balances, [0, 1], _root(0))
+    assert head == _root(2)
+    assert head == lmd_ghost_reference(store, balances, [0, 1], _root(0))
+
+
+def test_heavier_subtree_wins_over_longer_chain():
+    # chain A: 1 -> 3 (one voter), chain B: 2 (two heavy voters)
+    store = Store()
+    store.add_block(_root(0), _blk(0), None)
+    store.add_block(_root(1), _blk(1), _root(0))
+    store.add_block(_root(3), _blk(2), _root(1))
+    store.add_block(_root(2), _blk(1), _root(0))
+    balances = [32, 32, 32]
+    store.on_attestation([0], _root(3), slot=2)
+    store.on_attestation([1], _root(2), slot=1)
+    store.on_attestation([2], _root(2), slot=1)
+    assert lmd_ghost(store, balances, [0, 1, 2], _root(0)) == _root(2)
+
+
+def test_latest_message_highest_slot_wins():
+    store = Store()
+    store.add_block(_root(0), _blk(0), None)
+    store.add_block(_root(1), _blk(1), _root(0))
+    store.add_block(_root(2), _blk(1), _root(0))
+    store.on_attestation([0], _root(1), slot=5)
+    store.on_attestation([0], _root(2), slot=3)   # older: ignored
+    assert store.latest_messages[0].beacon_block_root == _root(1)
+    store.on_attestation([0], _root(2), slot=7)   # newer: replaces
+    assert store.latest_messages[0].beacon_block_root == _root(2)
+
+
+def test_zero_hash_target_aliases_genesis():
+    store = Store()
+    store.add_block(_root(0), _blk(0), None)
+    store.on_attestation([0], b"\x00" * 32, slot=1)
+    assert store.latest_messages[0].beacon_block_root == _root(0)
+
+
+def test_subtree_weights_direct_and_accumulated():
+    store = Store()
+    store.add_block(_root(0), _blk(0), None)
+    store.add_block(_root(1), _blk(1), _root(0))
+    store.add_block(_root(2), _blk(2), _root(1))
+    balances = np.asarray([10, 20, 0], dtype=np.uint64)
+    store.on_attestation([0], _root(1), slot=1)
+    store.on_attestation([1], _root(2), slot=2)
+    w = subtree_weights(store, balances, [0, 1, 2])
+    assert list(w) == [30, 30, 20]
+
+
+def test_unknown_attestation_target_ignored():
+    store = Store()
+    store.add_block(_root(0), _blk(0), None)
+    store.on_attestation([0], _root(9), slot=1)
+    assert 0 not in store.latest_messages
+
+
+def test_get_ancestor():
+    store = Store()
+    store.add_block(_root(0), _blk(0), None)
+    store.add_block(_root(1), _blk(2), _root(0))
+    store.add_block(_root(2), _blk(5), _root(1))
+    assert store.get_ancestor(2, 5) == 2
+    assert store.get_ancestor(2, 2) == 1
+    assert store.get_ancestor(2, 0) == 0
+    assert store.get_ancestor(2, 3) is None   # skipped slot
+    assert store.get_ancestor(0, 4) is None   # above the block
