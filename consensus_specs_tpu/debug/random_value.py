@@ -15,9 +15,8 @@ from random import Random
 from typing import Any
 
 from ..utils.ssz.typing import (
-    get_zero_value, is_bool_type, is_bytes_type, is_bytesn_type,
-    is_container_type, is_list_type, is_uint_type, is_vector_type,
-    uint_byte_size)
+    is_bool_type, is_bytes_type, is_bytesn_type, is_container_type,
+    is_list_type, is_uint_type, is_vector_type, uint_byte_size)
 
 # variable-length collections get lengths in this band unless told otherwise
 DEFAULT_MAX_LIST_LEN = 10
@@ -101,13 +100,13 @@ def get_random_ssz_object(rng: Random, typ: Any,
 
 
 def _collection_length(rng: Random, mode: RandomizationMode, max_len: int) -> int:
-    if mode == RandomizationMode.NIL:
-        return 0
+    if mode == RandomizationMode.ZERO or mode == RandomizationMode.NIL:
+        return 0   # ZERO means the canonical zero value: empty collections
     if mode == RandomizationMode.ONE:
         return 1
     if mode == RandomizationMode.LENGTHY:
         return rng.randrange(LENGTHY_MIN, LENGTHY_MAX + 1)
-    if mode == RandomizationMode.ZERO or mode == RandomizationMode.MAX:
+    if mode == RandomizationMode.MAX:
         return max_len
     return rng.randrange(max_len + 1)
 

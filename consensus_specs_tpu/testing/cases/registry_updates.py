@@ -1,0 +1,80 @@
+"""process_registry_updates scenario table.
+
+Per /root/reference specs/core/0_beacon-chain.md:1479-1503: eligible
+validators enter the activation queue and activate after the delay;
+validators under EJECTION_BALANCE get exit-initiated.
+"""
+from __future__ import annotations
+
+from .. import factories as f
+from . import Case, install_pytests
+
+
+def _at_epoch_end_run(spec, state):
+    """Seal the epoch's last slot, run the sub-transitions preceding
+    registry updates, then yield around process_registry_updates."""
+    target = state.slot + (spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH) - 1
+    block = f.empty_block_next(spec, state)
+    block.slot = target
+    f.sign_proposal(spec, state, block)
+    f.apply_and_seal(spec, state, block)
+
+    spec.process_slot(state)
+    spec.process_justification_and_finalization(state)
+    spec.process_crosslinks(state)
+    spec.process_rewards_and_penalties(state)
+
+    yield "pre", state
+    spec.process_registry_updates(state)
+    yield "post", state
+
+
+def activation(spec, state):
+    index = 0
+    subject = state.validator_registry[index]
+    # stage a fresh, not-yet-eligible validator with a full deposit
+    subject.activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    subject.activation_epoch = spec.FAR_FUTURE_EPOCH
+    subject.effective_balance = spec.MAX_EFFECTIVE_BALANCE
+    assert not spec.is_active_validator(subject, spec.get_current_epoch(state))
+
+    for _ in range(spec.ACTIVATION_EXIT_DELAY + 1):
+        f.advance_epoch(spec, state)
+
+    yield from _at_epoch_end_run(spec, state)
+
+    subject = state.validator_registry[index]
+    assert subject.activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
+    assert subject.activation_epoch != spec.FAR_FUTURE_EPOCH
+    assert spec.is_active_validator(subject, spec.get_current_epoch(state))
+
+
+def ejection(spec, state):
+    index = 0
+    subject = state.validator_registry[index]
+    assert spec.is_active_validator(subject, spec.get_current_epoch(state))
+    assert subject.exit_epoch == spec.FAR_FUTURE_EPOCH
+
+    subject.effective_balance = spec.EJECTION_BALANCE
+
+    for _ in range(spec.ACTIVATION_EXIT_DELAY + 1):
+        f.advance_epoch(spec, state)
+
+    yield from _at_epoch_end_run(spec, state)
+
+    subject = state.validator_registry[index]
+    assert subject.exit_epoch != spec.FAR_FUTURE_EPOCH
+    assert not spec.is_active_validator(subject, spec.get_current_epoch(state))
+
+
+CASES = [
+    Case("activation", build=activation),
+    Case("ejection", build=ejection),
+]
+
+
+def execute(spec, state, case):
+    yield from case.build(spec, state)
+
+
+install_pytests(globals(), CASES, execute)

@@ -11,7 +11,6 @@ import os
 
 from ..crypto import bls
 from ..models import phase0
-from .helpers.genesis import create_genesis_state
 from .utils import spectest, with_tags
 
 # BLS is off by default in unit tests, for speed — signature-semantics tests
@@ -34,8 +33,9 @@ def with_state(fn):
     def entry(*args, **kw):
         if "spec" not in kw:
             raise TypeError("spec decorator must come before state decorator")
+        from .factories import seed_genesis_state  # late: factories imports context
         spec = kw["spec"]
-        kw["state"] = create_genesis_state(spec=spec, num_validators=spec.SLOTS_PER_EPOCH * 8)
+        kw["state"] = seed_genesis_state(spec, spec.SLOTS_PER_EPOCH * 8)
         return fn(*args, **kw)
     entry.__name__ = fn.__name__
     return entry

@@ -11,7 +11,7 @@ test module down to the scenario logic.
 from __future__ import annotations
 
 from .context import expect_assertion_error
-from .helpers.state import get_balance
+from .factories import balance_of as get_balance
 
 
 def run_operation_processing(spec, state, op_name: str, operation, process_fn, valid=True):

@@ -14,10 +14,12 @@ import pytest
 from consensus_specs_tpu.crypto import bls
 from consensus_specs_tpu.models import phase0
 from consensus_specs_tpu.models.phase0.epoch_soa import process_epoch_soa
-from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
-from consensus_specs_tpu.testing.helpers.state import next_epoch
-from consensus_specs_tpu.testing.helpers.block import apply_empty_block
-from consensus_specs_tpu.testing.spec_tests.test_finality import next_epoch_with_attestations
+from consensus_specs_tpu.testing.cases.finality import attested_epoch
+from consensus_specs_tpu.testing.factories import (
+    advance_epoch as next_epoch,
+    seed_genesis_state as create_genesis_state,
+    transition_with_empty_block as apply_empty_block,
+)
 from consensus_specs_tpu.utils.ssz.impl import hash_tree_root
 
 
@@ -66,7 +68,7 @@ def test_epochs_with_attestations(spec):
     next_epoch(spec, state)
     apply_empty_block(spec, state)
     for fill_cur, fill_prev in ((True, False), (True, True), (False, True)):
-        _, _, state = next_epoch_with_attestations(spec, state, fill_cur, fill_prev)
+        _, _, state = attested_epoch(spec, state, current=fill_cur, previous=fill_prev)
         assert_same_epoch_transition(spec, deepcopy(state))
 
 
@@ -76,7 +78,7 @@ def test_justification_and_finalization_parity(spec):
     next_epoch(spec, state)
     apply_empty_block(spec, state)
     for _ in range(4):
-        _, _, state = next_epoch_with_attestations(spec, state, True, False)
+        _, _, state = attested_epoch(spec, state, current=True)
         assert_same_epoch_transition(spec, deepcopy(state))
     assert state.finalized_epoch > 0  # the scenario actually exercises finality
 
@@ -85,7 +87,7 @@ def test_slashed_and_ejected_validators(spec):
     state = create_genesis_state(spec, spec.SLOTS_PER_EPOCH * 8)
     next_epoch(spec, state)
     apply_empty_block(spec, state)
-    _, _, state = next_epoch_with_attestations(spec, state, True, True)
+    _, _, state = attested_epoch(spec, state, current=True, previous=True)
 
     rng = random.Random(1234)
     current_epoch = spec.get_current_epoch(state)
@@ -108,9 +110,9 @@ def test_slashed_and_ejected_validators(spec):
             state.validator_registry[i].effective_balance = spec.EJECTION_BALANCE
             state.balances[i] = spec.EJECTION_BALANCE
     # Fresh validators waiting on the activation queue
-    from consensus_specs_tpu.testing.helpers.genesis import build_mock_validator
+    from consensus_specs_tpu.testing.factories import seed_validator
     for k in range(6):
-        nv = build_mock_validator(spec, len(state.validator_registry), spec.MAX_EFFECTIVE_BALANCE)
+        nv = seed_validator(spec, len(state.validator_registry), spec.MAX_EFFECTIVE_BALANCE)
         nv.activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH if k % 3 == 0 else current_epoch - k % 2
         state.validator_registry.append(nv)
         state.balances.append(spec.MAX_EFFECTIVE_BALANCE)

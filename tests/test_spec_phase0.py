@@ -1,31 +1,31 @@
-"""Pytest collection shim for the dual-use spec test corpus.
+"""Pytest collection shim for the dual-use spec-test corpus.
 
-The corpus lives inside the package (consensus_specs_tpu/testing/spec_tests)
-so the vector generators can import the same functions; this module re-exports
-every test_* function for pytest discovery under tests/, suffixed with its
-module name to avoid cross-module shadowing (several modules define
-test_success etc.).
+The corpus lives inside the package (consensus_specs_tpu/testing/cases) as
+table-driven scenario modules, so the vector generators can run the same
+rows; this module re-exports every synthesized test_* entry for pytest
+discovery under tests/, suffixed with the table name to avoid cross-module
+shadowing (several tables define `success` etc.).
 """
 import importlib
 
-_CORPUS_MODULES = [
-    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_attestation",
-    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_attester_slashing",
-    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_block_header",
-    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_deposit",
-    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_proposer_slashing",
-    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_transfer",
-    "consensus_specs_tpu.testing.spec_tests.block_processing.test_process_voluntary_exit",
-    "consensus_specs_tpu.testing.spec_tests.epoch_processing.test_process_crosslinks",
-    "consensus_specs_tpu.testing.spec_tests.epoch_processing.test_process_registry_updates",
-    "consensus_specs_tpu.testing.spec_tests.sanity.test_blocks",
-    "consensus_specs_tpu.testing.spec_tests.sanity.test_slots",
-    "consensus_specs_tpu.testing.spec_tests.test_finality",
+_CASE_TABLES = [
+    "consensus_specs_tpu.testing.cases.attestation",
+    "consensus_specs_tpu.testing.cases.attester_slashing",
+    "consensus_specs_tpu.testing.cases.block_header",
+    "consensus_specs_tpu.testing.cases.deposit",
+    "consensus_specs_tpu.testing.cases.proposer_slashing",
+    "consensus_specs_tpu.testing.cases.transfer",
+    "consensus_specs_tpu.testing.cases.voluntary_exit",
+    "consensus_specs_tpu.testing.cases.crosslinks",
+    "consensus_specs_tpu.testing.cases.registry_updates",
+    "consensus_specs_tpu.testing.cases.sanity_blocks",
+    "consensus_specs_tpu.testing.cases.sanity_slots",
+    "consensus_specs_tpu.testing.cases.finality",
 ]
 
-for _mod_name in _CORPUS_MODULES:
+for _mod_name in _CASE_TABLES:
     _mod = importlib.import_module(_mod_name)
-    _suffix = _mod_name.rsplit(".", 1)[-1].removeprefix("test_")
+    _suffix = _mod_name.rsplit(".", 1)[-1]
     for _name, _fn in list(vars(_mod).items()):
         if _name.startswith("test_") and callable(_fn):
             globals()[f"{_name}__{_suffix}"] = _fn
