@@ -48,6 +48,11 @@ class Store:
     Blocks must be added parent-first (the reference requires recursively
     verified ancestors before processing a block, 0_fork-choice.md:38-41, so
     topological insertion order is guaranteed by the protocol).
+
+    Latest messages live in flat [V] arrays (`msg_target` block index or -1,
+    `msg_slot`), grown on demand — attestation intake and the vote
+    scatter-add are pure array ops, with no per-validator Python on the
+    fork-choice hot path.
     """
     genesis_root: bytes = b""
     # flattened block DAG
@@ -57,11 +62,31 @@ class Store:
     parents: List[int] = field(default_factory=list)     # index; -1 for genesis
     blocks: List[object] = field(default_factory=list)   # BeaconBlock objects
     children: List[List[int]] = field(default_factory=list)
-    # latest attestation message per validator index
-    latest_messages: Dict[int, LatestMessage] = field(default_factory=dict)
+    # latest attestation message per validator: [V] arrays, -1 = no message
+    msg_target: np.ndarray = field(
+        default_factory=lambda: np.full(0, -1, dtype=np.int64))
+    msg_slot: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, dtype=np.int64))
     # justification bookkeeping (highest seen)
     justified_root: bytes = b""
     finalized_root: bytes = b""
+
+    def _grow_messages(self, size: int) -> None:
+        if size > self.msg_target.shape[0]:
+            pad = size - self.msg_target.shape[0]
+            self.msg_target = np.concatenate(
+                [self.msg_target, np.full(pad, -1, dtype=np.int64)])
+            self.msg_slot = np.concatenate(
+                [self.msg_slot, np.zeros(pad, dtype=np.int64)])
+
+    @property
+    def latest_messages(self) -> Dict[int, LatestMessage]:
+        """Object view of the message arrays (oracle path / inspection)."""
+        return {
+            int(v): LatestMessage(slot=int(self.msg_slot[v]),
+                                  beacon_block_root=self.roots[int(self.msg_target[v])])
+            for v in np.nonzero(self.msg_target >= 0)[0]
+        }
 
     # -- block/attestation intake -------------------------------------------
 
@@ -89,17 +114,23 @@ class Store:
 
     def on_attestation(self, validator_indices: Sequence[int],
                        beacon_block_root: bytes, slot: int) -> None:
-        """Record latest messages for the attesting validators. ZERO_HASH
-        targets alias the genesis block (0_fork-choice.md:105-109)."""
+        """Record latest messages for the attesting validators (vectorized:
+        one masked write over the [V] arrays, however large the committee).
+        ZERO_HASH targets alias the genesis block (0_fork-choice.md:105-109);
+        a higher slot wins, first observation wins ties."""
         if beacon_block_root == b"\x00" * 32:
             beacon_block_root = self.genesis_root
         if beacon_block_root not in self.block_index:
             return  # unviable target: not yet observed
-        for v in validator_indices:
-            prev = self.latest_messages.get(int(v))
-            if prev is None or slot > prev.slot:
-                self.latest_messages[int(v)] = LatestMessage(
-                    slot=int(slot), beacon_block_root=beacon_block_root)
+        target = self.block_index[beacon_block_root]
+        idx = np.asarray(validator_indices, dtype=np.int64)
+        if idx.size == 0:
+            return
+        self._grow_messages(int(idx.max()) + 1)
+        newer = (self.msg_target[idx] < 0) | (int(slot) > self.msg_slot[idx])
+        take = idx[newer]
+        self.msg_target[take] = target
+        self.msg_slot[take] = int(slot)
 
     # -- reference-shaped object walk (oracle path) -------------------------
 
@@ -150,22 +181,24 @@ def subtree_weights(store: Store, effective_balances: np.ndarray,
                     active_indices: Sequence[int]) -> np.ndarray:
     """[B] uint64 subtree vote weight per block — the vectorized core.
 
-    Direct weights by one scatter-add over latest-message targets; subtree
-    accumulation by a single reverse-topological sweep (parents precede
-    children by insertion order, so a reverse linear scan is a valid
-    reverse-topological order)."""
+    Direct weights by ONE masked scatter-add over the [V] latest-message
+    arrays (no per-validator Python); subtree accumulation by a single
+    reverse-topological sweep over the (small) block array — parents
+    precede children by insertion order, so a reverse linear scan is a
+    valid reverse-topological order."""
     B = len(store.roots)
     direct = np.zeros(B, dtype=np.uint64)
-    active = set(int(v) for v in active_indices)
-    tgt_idx = []
-    tgt_w = []
-    for v, msg in store.latest_messages.items():
-        if v not in active:
-            continue
-        tgt_idx.append(store.block_index[msg.beacon_block_root])
-        tgt_w.append(int(effective_balances[v]))
-    if tgt_idx:
-        np.add.at(direct, np.asarray(tgt_idx), np.asarray(tgt_w, dtype=np.uint64))
+    V = store.msg_target.shape[0]
+    if V:
+        balances = np.zeros(V, dtype=np.uint64)
+        n = min(V, len(effective_balances))
+        balances[:n] = np.asarray(effective_balances[:n], dtype=np.uint64)
+        active = np.zeros(V, dtype=bool)
+        idx = np.asarray(active_indices, dtype=np.int64)
+        idx = idx[idx < V]
+        active[idx] = True
+        voting = active & (store.msg_target >= 0)
+        np.add.at(direct, store.msg_target[voting], balances[voting])
     acc = direct.copy()
     parents = np.asarray(store.parents)
     for i in range(B - 1, 0, -1):

@@ -29,14 +29,11 @@ from .keys import privkeys, pubkey_to_privkey, pubkeys
 
 
 def bit_on(bitfield: bytes, i: int) -> bytes:
-    """Copy of `bitfield` with bit i set (little-endian bit order per byte)."""
+    """Copy of `bitfield` with bit i set (little-endian bit order per byte;
+    reads go through spec.get_bitfield_bit)."""
     arr = bytearray(bitfield)
     arr[i // 8] |= 1 << (i % 8)
     return bytes(arr)
-
-
-def bit_at(bitfield: bytes, i: int) -> int:
-    return (bitfield[i // 8] >> (i % 8)) & 1
 
 
 # ---------------------------------------------------------------------------

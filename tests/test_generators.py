@@ -1,0 +1,81 @@
+"""Vector emission: suites render, write, and reload as valid YAML in the
+reference's layout (runner/handler nesting, suite header fields).
+
+Format contract: /root/reference specs/test_formats/README.md:104-188.
+BLS-bearing suites are exercised under the minimal preset with real
+signatures only where cheap (shuffling/ssz_static are crypto-free; the
+operations replay runs with bls off here — the CLI default emits with BLS
+on, which the corpus itself covers in test_bls_corpus).
+"""
+import os
+
+import yaml
+
+from consensus_specs_tpu.generators import suites
+from consensus_specs_tpu.generators.base import Suite, run_generator, write_suite
+from consensus_specs_tpu.generators.from_tables import cases_from_table, table
+
+
+def test_operations_suite_replays_table(tmp_path):
+    cases = cases_from_table(table("block_header"), "minimal", bls_default=False)
+    assert len(cases) == 5
+    ok = [c for c in cases if c.get("post") is not None]
+    bad = [c for c in cases if c.get("post") is None]
+    assert len(ok) >= 1 and len(bad) >= 3
+    for c in cases:
+        assert "pre" in c and "description" in c
+
+
+def test_sanity_slots_suite(tmp_path):
+    cases = cases_from_table(table("sanity_slots"), "minimal", bls_default=False)
+    assert len(cases) == 5
+    for c in cases:
+        assert isinstance(c["slots"], int)
+        assert c["post"] is not None
+
+
+def test_shuffling_suite_layout(tmp_path):
+    suite = suites.shuffling_suite("minimal")
+    path = write_suite(str(tmp_path), suite)
+    assert path.endswith(os.path.join("tests", "shuffling", "core", "core_minimal.yaml"))
+    doc = yaml.safe_load(open(path))
+    for key in ("title", "summary", "forks_timeline", "forks", "config",
+                "runner", "handler", "test_cases"):
+        assert key in doc
+    assert doc["runner"] == "shuffling"
+    sizes = [c["count"] for c in doc["test_cases"]]
+    assert sizes == sorted(sizes)
+    # permutation property
+    for c in doc["test_cases"]:
+        assert sorted(c["shuffled"]) == list(range(c["count"]))
+
+
+def test_ssz_static_suite_roundtrips(tmp_path):
+    suite = suites.ssz_static_suite("minimal")
+    assert suite.test_cases, "must emit cases for every container"
+    names = {c["type_name"] for c in suite.test_cases}
+    assert "BeaconState" in names and "Validator" in names
+    for c in suite.test_cases[:20]:
+        assert c["serialized"].startswith("0x")
+        assert len(c["root"]) == 66
+
+
+def test_run_generator_cli(tmp_path):
+    out = run_generator(
+        "shuffling", [suites.shuffling_suite],
+        argv=["-o", str(tmp_path), "-p", "minimal"])
+    assert len(out) == 1
+    assert os.path.exists(out[0])
+
+
+def test_epoch_processing_suite(tmp_path):
+    cases = cases_from_table(table("registry_updates"), "minimal", bls_default=False)
+    assert len(cases) == 2
+    for c in cases:
+        assert c["post"] is not None
+
+
+def test_dry_run_writes_nothing(tmp_path, capsys):
+    run_generator("shuffling", [suites.shuffling_suite],
+                  argv=["-o", str(tmp_path), "-p", "minimal", "--dry"])
+    assert not os.path.exists(os.path.join(str(tmp_path), "tests"))
