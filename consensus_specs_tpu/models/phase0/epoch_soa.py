@@ -484,3 +484,62 @@ def process_epoch_soa(spec, state) -> None:
 
     # Host-side final updates (:1526-1564), byte-rooted parts (shared helper)
     spec.final_updates_byte_rooted(state)
+
+
+def synthetic_epoch_state(cfg: EpochConfig, V: int, rng,
+                          slashed_p: float = 0.05,
+                          incl_delay_max: int = 8,
+                          random_eligibility: bool = False,
+                          random_slashed_balances: bool = False):
+    """Plausible random (cols, scal, inp) for benches/dryruns/mesh tests —
+    the ONE example-state builder shared by bench.py, __graft_entry__, and
+    tests/test_multichip.py so placement/shape drift cannot split them."""
+    FAR = cfg.FAR_FUTURE_EPOCH
+    MAX_EB = 32_000_000_000
+    if random_eligibility:
+        elig = jnp.asarray(np.where(rng.random(V) < 0.1, FAR, 0).astype(np.uint64))
+        act = jnp.asarray(np.where(rng.random(V) < 0.1, FAR, 0).astype(np.uint64))
+    else:
+        elig = jnp.zeros(V, jnp.uint64)
+        act = jnp.zeros(V, jnp.uint64)
+    cols = ValidatorColumns(
+        activation_eligibility_epoch=elig,
+        activation_epoch=act,
+        exit_epoch=jnp.full(V, FAR, jnp.uint64),
+        withdrawable_epoch=jnp.full(V, FAR, jnp.uint64),
+        slashed=jnp.asarray(rng.random(V) < slashed_p),
+        effective_balance=jnp.full(V, MAX_EB, jnp.uint64),
+        balance=jnp.asarray(
+            rng.integers(MAX_EB - 10 ** 9, MAX_EB + 10 ** 9, V).astype(np.uint64)),
+    )
+    if random_slashed_balances:
+        lsb = jnp.asarray(rng.integers(
+            0, 10 ** 12, cfg.LATEST_SLASHED_EXIT_LENGTH).astype(np.uint64))
+    else:
+        lsb = jnp.zeros(cfg.LATEST_SLASHED_EXIT_LENGTH, jnp.uint64)
+    scal = EpochScalars(
+        slot=jnp.uint64(10 * cfg.SLOTS_PER_EPOCH - 1),
+        previous_justified_epoch=jnp.uint64(7),
+        current_justified_epoch=jnp.uint64(8),
+        justification_bitfield=jnp.uint64(0b1111),
+        finalized_epoch=jnp.uint64(7),
+        latest_start_shard=jnp.uint64(0),
+        latest_slashed_balances=lsb,
+    )
+    comm_bal = np.maximum(
+        np.full(cfg.SHARD_COUNT, (V // max(1, cfg.SHARD_COUNT)) * MAX_EB,
+                dtype=np.uint64), 1)
+    inp = EpochInputs(
+        prev_src=jnp.asarray(rng.random(V) < 0.95),
+        prev_tgt=jnp.asarray(rng.random(V) < 0.90),
+        prev_head=jnp.asarray(rng.random(V) < 0.85),
+        curr_tgt=jnp.asarray(rng.random(V) < 0.90),
+        incl_delay=jnp.asarray(
+            rng.integers(1, incl_delay_max + 1, V).astype(np.uint64)),
+        att_proposer=jnp.asarray(rng.integers(0, V, V).astype(np.int32)),
+        v_shard=jnp.asarray(rng.integers(0, cfg.SHARD_COUNT, V).astype(np.int32)),
+        in_winning=jnp.asarray(rng.random(V) < 0.90),
+        shard_att_balance=jnp.asarray((comm_bal * 9) // 10 + 1),
+        shard_comm_balance=jnp.asarray(comm_bal),
+    )
+    return cols, scal, inp

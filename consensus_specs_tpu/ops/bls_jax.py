@@ -316,6 +316,32 @@ def pairing_product_is_one(g1_batch, g2_batch):
 _pairing_check_jit = jax.jit(pairing_product_is_one)
 
 
+def grouped_pairing_check(g1, g2):
+    """[G] independent product-of-pairings checks in ONE device program.
+
+    g1 [G, P, 2, L], g2 [G, P, 2, 2, L]: group g passes iff
+    prod_p e(P_gp, Q_gp) == 1. The throughput shape for a block's
+    attestations (spec bls_verify_multiple per attestation,
+    /root/reference specs/bls_signature.md:139-146, called per op at
+    0_beacon-chain.md:1022-1034): all G*P Miller loops run as one batch,
+    the within-group product is a short fori over P, and the final
+    exponentiation runs batched over all G groups at once."""
+    G, P = g1.shape[0], g1.shape[1]
+    fs = miller_loop_batch(g1.reshape((G * P,) + g1.shape[2:]),
+                           g2.reshape((G * P,) + g2.shape[2:]))
+    fs = fs.reshape((G, P) + fs.shape[1:])
+
+    def body(p, acc):
+        return T.fq12_mul(acc, fs[:, p])
+
+    f = jax.lax.fori_loop(0, P, body, T.fq12_ones((G,)))
+    res = final_exponentiation_3x(f)
+    return T.fq12_eq(res, T.fq12_ones((G,)))
+
+
+_grouped_pairing_check_jit = jax.jit(grouped_pairing_check)
+
+
 # ---------------------------------------------------------------------------
 # Aggregation trees + scalar mul (jitted, shape-cached)
 # ---------------------------------------------------------------------------
@@ -405,6 +431,58 @@ class JaxBackend:
     def verify(self, pubkey: bytes, message_hash: bytes, signature: bytes,
                domain: int) -> bool:
         return self.verify_multiple([pubkey], [message_hash], signature, domain)
+
+    def verify_multiple_batch(self, items: Sequence[Tuple[Sequence[bytes],
+                                                          Sequence[bytes],
+                                                          bytes, int]]) -> List[bool]:
+        """Batch of independent aggregate-verifies (a block's attestations):
+        items of (pubkeys, message_hashes, signature, domain). Per-item
+        verdicts are EXACTLY verify_multiple's: infinity points skip their
+        pair (their Miller loop contributes one, matching the bignum
+        oracle), an undecodable encoding or length mismatch fails the item,
+        and an item whose product is empty passes trivially.
+
+        Items are grouped by surviving pair count; each group of G items
+        with P pairs runs as one grouped device program (G padded to the
+        next power of two with copies of the group's last item, so the jit
+        cache sees log-many shapes)."""
+        staged: List[Optional[List[Tuple[object, object]]]] = []
+        for pubkeys, message_hashes, signature, domain in items:
+            try:
+                assert len(pubkeys) == len(message_hashes)
+                sig_pt = gt.decompress_g2(signature)
+                pairs = [(gt.ec_neg(gt.G1_GEN), sig_pt)]
+                for pk, mh in zip(pubkeys, message_hashes):
+                    pairs.append((gt.decompress_g1(pk), gt.hash_to_g2(mh, domain)))
+            except AssertionError:
+                staged.append(None)
+                continue
+            staged.append([(a, b) for a, b in pairs
+                           if a is not None and b is not None])
+
+        results = [False] * len(items)
+        by_count: dict = {}
+        for i, pairs in enumerate(staged):
+            if pairs is None:
+                continue
+            if not pairs:
+                results[i] = True   # empty product
+                continue
+            by_count.setdefault(len(pairs), []).append(i)
+
+        for count, members in by_count.items():
+            g = _next_pow2(len(members))
+            g1 = np.zeros((g, count, 2, F.L), np.int64)
+            g2 = np.zeros((g, count, 2, 2, F.L), np.int64)
+            for j in range(g):
+                pairs = staged[members[min(j, len(members) - 1)]]
+                g1[j] = np.stack([g1_to_limbs(a) for a, _ in pairs])
+                g2[j] = np.stack([g2_to_limbs(b) for _, b in pairs])
+            ok = np.asarray(_grouped_pairing_check_jit(jnp.asarray(g1),
+                                                       jnp.asarray(g2)))
+            for j, i in enumerate(members):
+                results[i] = bool(ok[j])
+        return results
 
     def verify_multiple(self, pubkeys: Sequence[bytes],
                         message_hashes: Sequence[bytes],

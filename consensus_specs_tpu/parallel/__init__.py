@@ -1,0 +1,11 @@
+"""Multi-chip sharding layer: meshes, placement rules, equality checks.
+
+The reference has no distributed backend (its networking is paper-spec
+only, /root/reference specs/networking/); here multi-chip scale comes from
+`jax.sharding` over a validator-axis Mesh with XLA-inserted collectives
+(SURVEY.md §2c). This package is the single home for placement policy so
+the driver dry-run, the pytest mesh suite, and production entry points all
+stage state identically.
+"""
+from .sharding import (  # noqa: F401
+    shard_epoch_state, trees_bitwise_equal, validator_mesh)

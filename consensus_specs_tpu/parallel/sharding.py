@@ -1,0 +1,57 @@
+"""Validator-axis sharding policy for the SoA epoch state.
+
+Placement contract (SURVEY.md §2c: the registry is the protocol's
+embarrassingly-parallel axis):
+  - every `[V]` column of ValidatorColumns / EpochInputs shards over the
+    mesh's "v" axis;
+  - scalars and per-shard tables (EpochScalars, the two shard-balance
+    tables) replicate — they feed cross-shard reductions XLA lowers to
+    psum/all-gather collectives over ICI.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.phase0.epoch_soa import (
+    EpochInputs, EpochScalars, ValidatorColumns)
+
+
+def validator_mesh(devices=None, n: int = None) -> Mesh:
+    """A 1-D mesh over the validator axis ("v")."""
+    if devices is None:
+        devices = jax.devices()
+    if n is not None:
+        assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
+        devices = devices[:n]
+    return Mesh(np.asarray(devices), axis_names=("v",))
+
+
+def shard_epoch_state(mesh: Mesh, cols: ValidatorColumns, scal: EpochScalars,
+                      inp: EpochInputs):
+    """Place one epoch step's inputs per the contract above."""
+    shard_v = NamedSharding(mesh, P("v"))
+    repl = NamedSharding(mesh, P())
+    cols_s = ValidatorColumns(*(jax.device_put(x, shard_v) for x in cols))
+    scal_s = EpochScalars(*(jax.device_put(x, repl) for x in scal))
+    n_vcols = len(EpochInputs._fields) - 2   # trailing 2 are shard tables
+    inp_s = EpochInputs(
+        *(jax.device_put(x, shard_v) for x in inp[:n_vcols]),
+        shard_att_balance=jax.device_put(inp.shard_att_balance, repl),
+        shard_comm_balance=jax.device_put(inp.shard_comm_balance, repl),
+    )
+    return cols_s, scal_s, inp_s
+
+
+def trees_bitwise_equal(a, b) -> bool:
+    """Leafwise dtype/shape/value equality of two pytrees (host compare)."""
+    leaves_a = jax.tree_util.tree_leaves(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    if len(leaves_a) != len(leaves_b):
+        return False
+    for x, y in zip(leaves_a, leaves_b):
+        xn, yn = np.asarray(x), np.asarray(y)
+        if xn.dtype != yn.dtype or xn.shape != yn.shape or not (xn == yn).all():
+            return False
+    return True

@@ -214,3 +214,54 @@ def test_registered_backend_switch():
     finally:
         bls.set_backend("python")
     assert bls.bls_verify(pub, msg, sig, DOMAIN)  # python agrees on same bytes
+
+
+def test_verify_multiple_batch(backends):
+    """Grouped device check == per-item oracle verdicts, mixed valid/invalid."""
+    py, jx = backends
+    items = []
+    expected = []
+    for i, (k0, k1) in enumerate([(3, 4), (5, 6), (9, 10)]):
+        msgs = [bytes([i + 1]) * 32, bytes([i + 7]) * 32]
+        agg = py.aggregate_signatures(
+            [py.sign(m, k, DOMAIN) for m, k in zip(msgs, (k0, k1))])
+        pubs = [gt.privtopub(k0), gt.privtopub(k1)]
+        if i == 1:  # corrupt the middle item's message pairing
+            msgs = msgs[::-1]
+        items.append((pubs, msgs, agg, DOMAIN))
+        expected.append(py.verify_multiple(pubs, msgs, agg, DOMAIN))
+    got = jx.verify_multiple_batch(items)
+    assert got == expected == [True, False, True]
+
+
+def test_verify_multiple_batch_bad_encoding(backends):
+    """A stage-failing item yields False without poisoning the batch."""
+    py, jx = backends
+    msg = b"\x21" * 32
+    agg = py.aggregate_signatures([py.sign(msg, 11, DOMAIN)])
+    pubs = [gt.privtopub(11)]
+    good = (pubs, [msg], agg, DOMAIN)
+    bad = (pubs, [msg], b"\xff" * 96, DOMAIN)   # undecodable signature
+    got = jx.verify_multiple_batch([good, bad, good])
+    assert got == [True, False, True]
+
+
+def test_verify_multiple_batch_ragged_and_infinity(backends):
+    """Mixed pair counts in one batch, plus the oracle's infinity semantics:
+    an all-infinity item is an empty product (True), exactly like
+    verify_multiple."""
+    py, jx = backends
+    msg = b"\x31" * 32
+    one = (
+        [gt.privtopub(13)], [msg],
+        py.aggregate_signatures([py.sign(msg, 13, DOMAIN)]), DOMAIN)
+    two_msgs = [b"\x32" * 32, b"\x33" * 32]
+    two = (
+        [gt.privtopub(14), gt.privtopub(15)], two_msgs,
+        py.aggregate_signatures(
+            [py.sign(m, k, DOMAIN) for m, k in zip(two_msgs, (14, 15))]),
+        DOMAIN)
+    empty = ([], [], gt.compress_g2(None), DOMAIN)   # infinity signature
+    assert py.verify_multiple(*empty)                # oracle: empty product
+    got = jx.verify_multiple_batch([one, empty, two])
+    assert got == [True, True, True]

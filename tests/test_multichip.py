@@ -1,0 +1,71 @@
+"""Multi-device sharding correctness: sharded == single-device, bit for bit.
+
+The protocol's data-parallel axis is the validator registry (SURVEY.md §2c);
+these tests jit the SAME epoch program once per placement — all inputs on
+one device vs `[V]` columns sharded over an explicit 8-device Mesh — and
+require bit-identical outputs. XLA inserts the cross-shard collectives
+(balance-sum reductions, proposer scatter-add, activation-queue sort);
+equality proves the sharded program is semantically the single-chip one.
+
+Runs on the virtual 8-device CPU mesh the conftest pins; the driver's
+dryrun_multichip does the same check at entry level.
+"""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from consensus_specs_tpu.models import phase0
+from consensus_specs_tpu.parallel import (
+    shard_epoch_state, trees_bitwise_equal, validator_mesh)
+from consensus_specs_tpu.models.phase0.epoch_soa import (
+    EpochConfig, epoch_transition_device, synthetic_epoch_state)
+
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < N_DEV:
+        pytest.skip(f"needs {N_DEV} devices, have {len(jax.devices())}")
+    return validator_mesh(n=N_DEV)
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_epoch_transition_sharded_equals_single(mesh, seed):
+    spec = phase0.get_spec("minimal")
+    cfg = EpochConfig.from_spec(spec)
+    V = 64 * N_DEV
+    cols, scal, inp = synthetic_epoch_state(
+        cfg, V, np.random.default_rng(seed), random_eligibility=True,
+        random_slashed_balances=True)
+
+    single = epoch_transition_device(cfg, cols, scal, inp)
+    jax.block_until_ready(single)
+
+    cols_s, scal_s, inp_s = shard_epoch_state(mesh, cols, scal, inp)
+    sharded = jax.jit(
+        lambda c, s, i: epoch_transition_device(cfg, c, s, i)
+    )(cols_s, scal_s, inp_s)
+    jax.block_until_ready(sharded)
+
+    assert trees_bitwise_equal(single, sharded)
+
+
+def test_sharded_output_actually_sharded(mesh):
+    """The result's [V] columns must come back sharded over the mesh —
+    i.e. the program ran SPMD, not via a gather-to-one-device fallback."""
+    spec = phase0.get_spec("minimal")
+    cfg = EpochConfig.from_spec(spec)
+    cols, scal, inp = synthetic_epoch_state(
+        cfg, 64 * N_DEV, np.random.default_rng(1), random_eligibility=True)
+    cols_s, scal_s, inp_s = shard_epoch_state(mesh, cols, scal, inp)
+    shard_v = NamedSharding(mesh, P("v"))
+    out_cols, _, _ = jax.jit(
+        lambda c, s, i: epoch_transition_device(cfg, c, s, i),
+        out_shardings=(
+            jax.tree_util.tree_map(lambda _: shard_v, cols_s),
+            None, None),
+    )(cols_s, scal_s, inp_s)
+    jax.block_until_ready(out_cols)
+    assert out_cols.balance.sharding.is_equivalent_to(shard_v, out_cols.balance.ndim)
