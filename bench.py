@@ -101,10 +101,11 @@ def _stage_attestation_pairs(n_groups):
     pk1/H(m,1)) with real signatures so every group verifies true."""
     from consensus_specs_tpu.crypto import bls12_381 as gt
     from consensus_specs_tpu.ops import bls_jax as B
+    from consensus_specs_tpu.ops import fq as F
 
     py = gt.PythonBackend()
-    g1 = np.zeros((n_groups, 3, 2, 14), np.int64)
-    g2 = np.zeros((n_groups, 3, 2, 2, 14), np.int64)
+    g1 = np.zeros((n_groups, 3, 2, F.L), np.int64)
+    g2 = np.zeros((n_groups, 3, 2, 2, F.L), np.int64)
     for g in range(n_groups):
         msg = bytes([g % 256]) * 32
         k0, k1 = 2 * g + 1, 2 * g + 2

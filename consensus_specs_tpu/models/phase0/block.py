@@ -68,6 +68,14 @@ def process_operations(spec, state, body) -> None:
         for operation in operations:
             handler(state, operation)
 
+    # Later phases append operation families after all phase-0 ops (the
+    # reference appends them via spec-doc ordering, 1_custody-game.md:330+)
+    for body_attr, max_operations, handler in spec._extra_block_operations:
+        operations = getattr(body, body_attr)
+        assert len(operations) <= max_operations
+        for operation in operations:
+            handler(state, operation)
+
 
 def process_proposer_slashing(spec, state, proposer_slashing) -> None:
     proposer = state.validator_registry[proposer_slashing.proposer_index]

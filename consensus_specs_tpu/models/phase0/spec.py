@@ -50,9 +50,11 @@ class Phase0Spec:
         for mod in _FUNCTION_MODULES:
             self._bind_module(mod)
 
-        # Phase-1 insert hooks (reference's `# @label` mechanism)
+        # Phase-1 insert hooks (reference's `# @label` mechanism) and the
+        # appended-operation-family hook consumed by process_operations
         self._insert_after_registry_updates = []
         self._insert_after_final_updates = []
+        self._extra_block_operations = []   # (body_attr, max_count, handler)
 
         # Caches (reference epilogue: build_spec.py:78-105)
         self._hash_cache: Dict[bytes, bytes] = {}

@@ -446,19 +446,7 @@ class JaxBackend:
         with P pairs runs as one grouped device program (G padded to the
         next power of two with copies of the group's last item, so the jit
         cache sees log-many shapes)."""
-        staged: List[Optional[List[Tuple[object, object]]]] = []
-        for pubkeys, message_hashes, signature, domain in items:
-            try:
-                assert len(pubkeys) == len(message_hashes)
-                sig_pt = gt.decompress_g2(signature)
-                pairs = [(gt.ec_neg(gt.G1_GEN), sig_pt)]
-                for pk, mh in zip(pubkeys, message_hashes):
-                    pairs.append((gt.decompress_g1(pk), gt.hash_to_g2(mh, domain)))
-            except AssertionError:
-                staged.append(None)
-                continue
-            staged.append([(a, b) for a, b in pairs
-                           if a is not None and b is not None])
+        staged = [self._stage_pairs(*item) for item in items]
 
         results = [False] * len(items)
         by_count: dict = {}
@@ -484,18 +472,31 @@ class JaxBackend:
                 results[i] = bool(ok[j])
         return results
 
-    def verify_multiple(self, pubkeys: Sequence[bytes],
-                        message_hashes: Sequence[bytes],
-                        signature: bytes, domain: int) -> bool:
+    @staticmethod
+    def _stage_pairs(pubkeys: Sequence[bytes], message_hashes: Sequence[bytes],
+                     signature: bytes, domain: int
+                     ) -> Optional[List[Tuple[object, object]]]:
+        """One aggregate-verify's pairing inputs: [(negG1, sig), (pk_i,
+        H(m_i))...] with infinity pairs dropped (their Miller loop
+        contributes one). None = undecodable/ill-formed -> verdict False.
+        The single source of staging truth for verify_multiple AND
+        verify_multiple_batch (their verdicts must match exactly)."""
         try:
             assert len(pubkeys) == len(message_hashes)
             sig_pt = gt.decompress_g2(signature)
-            pk_pts = [gt.decompress_g1(p) for p in pubkeys]
+            pairs: List[Tuple[object, object]] = [(gt.ec_neg(gt.G1_GEN), sig_pt)]
+            for pk, mh in zip(pubkeys, message_hashes):
+                pairs.append((gt.decompress_g1(pk), gt.hash_to_g2(mh, domain)))
         except AssertionError:
+            return None
+        return [(a, b) for a, b in pairs if a is not None and b is not None]
+
+    def verify_multiple(self, pubkeys: Sequence[bytes],
+                        message_hashes: Sequence[bytes],
+                        signature: bytes, domain: int) -> bool:
+        pairs = self._stage_pairs(pubkeys, message_hashes, signature, domain)
+        if pairs is None:
             return False
-        pairs: List[Tuple[object, object]] = [(gt.ec_neg(gt.G1_GEN), sig_pt)]
-        for pk, mh in zip(pk_pts, message_hashes):
-            pairs.append((pk, gt.hash_to_g2(mh, domain)))
         return self._check_pairs(pairs)
 
     # -- aggregation --------------------------------------------------------

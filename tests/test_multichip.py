@@ -52,20 +52,18 @@ def test_epoch_transition_sharded_equals_single(mesh, seed):
     assert trees_bitwise_equal(single, sharded)
 
 
-def test_sharded_output_actually_sharded(mesh):
-    """The result's [V] columns must come back sharded over the mesh —
-    i.e. the program ran SPMD, not via a gather-to-one-device fallback."""
+def test_sharded_output_stays_sharded(mesh):
+    """With output shardings left to propagation, the result's [V] columns
+    must come back sharded over the mesh — i.e. the partitioner kept the
+    program SPMD instead of gathering to one device."""
     spec = phase0.get_spec("minimal")
     cfg = EpochConfig.from_spec(spec)
     cols, scal, inp = synthetic_epoch_state(
         cfg, 64 * N_DEV, np.random.default_rng(1), random_eligibility=True)
     cols_s, scal_s, inp_s = shard_epoch_state(mesh, cols, scal, inp)
-    shard_v = NamedSharding(mesh, P("v"))
     out_cols, _, _ = jax.jit(
-        lambda c, s, i: epoch_transition_device(cfg, c, s, i),
-        out_shardings=(
-            jax.tree_util.tree_map(lambda _: shard_v, cols_s),
-            None, None),
+        lambda c, s, i: epoch_transition_device(cfg, c, s, i)
     )(cols_s, scal_s, inp_s)
     jax.block_until_ready(out_cols)
+    shard_v = NamedSharding(mesh, P("v"))
     assert out_cols.balance.sharding.is_equivalent_to(shard_v, out_cols.balance.ndim)
