@@ -13,7 +13,7 @@ must agree bit-for-bit; the conformance tests diff them.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Sequence
+from typing import Callable, Dict, Sequence
 
 bls_active = True
 

@@ -19,7 +19,7 @@ below is derived from the curve parameters and standard formulas.
 from __future__ import annotations
 
 import hashlib
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 # ---------------------------------------------------------------------------
 # Parameters
@@ -28,7 +28,10 @@ from typing import List, Optional, Sequence, Tuple
 q = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
 r = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
 BLS_X = 0xD201000000010000  # |x|; the BLS parameter is -x
-G2_COFACTOR = 305502333931268344200999753193121504214466019254188142667664032982267604182971884026507427359259977847832272839041616661285803823378372096355777062779109
+G2_COFACTOR = int(
+    "30550233393126834420099975319312150421446601925418814266766403298226"
+    "76041829718840265074273592599778478322728390416166612858038233783720"
+    "96355777062779109")
 
 G1_GEN = (
     3685416753713387016781088315183077757961620795782546409894578378688607592378376318836054947676345821548104185464507,
@@ -116,12 +119,16 @@ G2_B = Fq2(4, 4)        # E': y^2 = x^3 + 4(1 + u)
 
 G2_GEN = (
     Fq2(
-        352701069587466618187139116011060144890029952792775240219908644239793785735715026873347600343865175952761926303160,
-        3059144344244213709971259814753781636986470325476647558659373206291635324768958432433509563104347017837885763365758,
+        int("352701069587466618187139116011060144890029952792775240219"
+            "908644239793785735715026873347600343865175952761926303160"),
+        int("305914434424421370997125981475378163698647032547664755865"
+            "9373206291635324768958432433509563104347017837885763365758"),
     ),
     Fq2(
-        1985150602287291935568054521177171638300868978215655730859378665066344726373823718423869104263333984641494340347905,
-        927553665492332455747201965776037880757740193453592970025027978793976877002675564980949289727957565575433344219582,
+        int("198515060228729193556805452117717163830086897821565573085"
+            "9378665066344726373823718423869104263333984641494340347905"),
+        int("927553665492332455747201965776037880757740193453592970025"
+            "027978793976877002675564980949289727957565575433344219582"),
     ),
 )
 

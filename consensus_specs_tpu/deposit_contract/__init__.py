@@ -1,0 +1,10 @@
+"""Deposit contract model: the eth1-side incremental Merkle accumulator.
+
+Port of /root/reference deposit_contract/contracts/
+validator_registration.v.py (Vyper/EVM there; a host-side Python model
+here — the EVM is outside this framework's scope, but the accumulator
+algorithm and its differential contract against the consensus-side SSZ
+hash_tree_root(DepositData) are capability we must carry:
+deposit() :69-140, get_deposit_root :51-62, Eth2Genesis trigger :128-140).
+"""
+from .contract import DepositContract, DepositEvent, Eth2GenesisEvent  # noqa: F401

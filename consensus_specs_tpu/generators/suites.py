@@ -213,10 +213,9 @@ def ssz_static_suite(preset: str) -> Suite:
     """Serialized bytes + roots for randomized instances of every phase-0
     container (format: specs/test_formats/ssz_static/core.md)."""
     spec = phase0.get_spec(preset)
-    from ..models.phase0 import containers
     rng = Random(412)
     cases: List[dict] = []
-    for name in sorted(containers.build_types(spec).keys()):
+    for name in sorted(spec.container_types.keys()):
         typ = getattr(spec, name)
         for mode, repeats in _SSZ_MODES:
             for _ in range(repeats):

@@ -1,0 +1,11 @@
+"""Light-client support: generalized indices, Merkle multiproofs, partials.
+
+Capability parity with /root/reference specs/light_client/
+(merkle_proofs.md: generalized tree indices :26-104, multiproofs :106-165,
+MerklePartial :167-187). These give light clients O(log N) access into the
+beacon state — the reference's "ring-attention equivalent" access pattern
+(SURVEY.md §5).
+"""
+from .multiproof import (  # noqa: F401
+    MerklePartial, SSZMerkleTree, generalized_index_for_path,
+    get_helper_indices, merkle_tree_nodes, verify_multiproof)

@@ -14,7 +14,7 @@ one-point spec semantics and as the oracle the batched path is tested against.
 from __future__ import annotations
 
 import hashlib
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence
 
 import numpy as np
 

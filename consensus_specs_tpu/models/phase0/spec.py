@@ -21,8 +21,9 @@ from . import containers
 from . import epoch as epoch_mod
 from . import genesis as genesis_mod
 from . import helpers as helpers_mod
+from . import validator as validator_mod
 
-_FUNCTION_MODULES = (helpers_mod, epoch_mod, block_mod, genesis_mod)
+_FUNCTION_MODULES = (helpers_mod, epoch_mod, block_mod, genesis_mod, validator_mod)
 
 
 class Phase0Spec:
@@ -42,8 +43,10 @@ class Phase0Spec:
         # backend selection apply to all spec objects at once.
         self.bls = bls
 
-        # SSZ container types specialized to this preset's shapes
-        for type_name, typ in containers.build_types(self).items():
+        # SSZ container types specialized to this preset's shapes (the dict
+        # is kept so later phases extend THESE classes, not fresh rebuilds)
+        self.container_types: Dict[str, type] = containers.build_types(self)
+        for type_name, typ in self.container_types.items():
             setattr(self, type_name, typ)
 
         # Spec functions -> bound methods

@@ -152,11 +152,10 @@ def build_types(cfg: Any, p0: Dict[str, type]) -> Dict[str, type]:
         "early_derived_secret_reveals": List[ts["EarlyDerivedSecretReveal"]],
     }, p0["BeaconBlockBody"])
 
+    # re-annotating `body` overrides its type IN PLACE (the MRO field walk
+    # dict.update()s, keeping the phase-0 field order) — not an append
     ts["BeaconBlock"] = _container("BeaconBlock", {
-        # re-declare so the body field uses the phase-1 body type; order of
-        # phase-0 fields is preserved by the MRO walk, and annotating an
-        # existing name overrides its type in place (not an append)
+        "body": ts["BeaconBlockBody"],
     }, p0["BeaconBlock"])
-    ts["BeaconBlock"].__annotations__ = {"body": ts["BeaconBlockBody"]}
 
     return ts

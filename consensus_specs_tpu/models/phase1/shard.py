@@ -138,11 +138,14 @@ def compute_crosslink_data_root(spec, blocks: Sequence) -> bytes:
         chunks = [padded[i:i + 32] for i in range(0, len(padded), 32)] or [b"\x00" * 32]
         return spec.hash_tree_root(chunks, SSZList[Bytes32])
 
+    zero_root_cache = []
+
     def padded_roots(roots: List[bytes]) -> List[bytes]:
         out = list(roots)
-        zero_root = chunked_root(b"\x00" * body_len)
         while len(out) & (len(out) - 1) or not out:
-            out.append(zero_root)
+            if not zero_root_cache:   # hash the 16 KiB zero body at most once
+                zero_root_cache.append(chunked_root(b"\x00" * body_len))
+            out.append(zero_root_cache[0])
         return out
 
     header_roots = [
@@ -237,9 +240,11 @@ def is_valid_beacon_attestation(spec, shard: int, shard_blocks, beacon_state,
              candidate.data.crosslink.parent_root), None)
         assert previous is not None
 
-    # crosslink data root covers the canonical shard blocks in its window
+    # crosslink data root covers the canonical shard blocks from the last
+    # crosslink the STATE accepted for this shard (not whatever the
+    # candidate claims) up to the lookback horizon
     candidate_slot = spec.get_attestation_data_slot(beacon_state, candidate.data)
-    start_epoch = candidate.data.crosslink.start_epoch
+    start_epoch = beacon_state.current_crosslinks[shard].end_epoch
     end_epoch = min(spec.slot_to_epoch(candidate_slot) - spec.CROSSLINK_LOOKBACK,
                     start_epoch + spec.MAX_EPOCHS_PER_CROSSLINK)
     blocks = [shard_blocks[slot]

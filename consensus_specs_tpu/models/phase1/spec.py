@@ -14,7 +14,6 @@ from __future__ import annotations
 from typing import Dict, Union
 
 from ...utils.config import Preset, load_preset
-from ..phase0 import containers as containers0
 from ..phase0.spec import Phase0Spec
 from . import constants as c1
 from . import containers as containers1
@@ -39,8 +38,11 @@ class Phase1Spec(Phase0Spec):
                 setattr(self, key, value)
 
         # Containers: new custody/shard types + field-appended phase-0 types
-        p0_types = containers0.build_types(self)
-        for name, typ in containers1.build_types(self, p0_types).items():
+        # (extending the classes Phase0Spec already built — one identity per
+        # type per spec, so isinstance stays coherent across phases)
+        phase1_types = containers1.build_types(self, self.container_types)
+        self.container_types.update(phase1_types)
+        for name, typ in phase1_types.items():
             setattr(self, name, typ)
 
         # Custody + shard functions as bound methods

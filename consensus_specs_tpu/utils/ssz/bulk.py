@@ -26,15 +26,15 @@ tests/test_bulk_htr.py). `state_root_bulk` is the BeaconState entry point.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List as PyList, Optional, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
 from ..hash import ZERO_BYTES32, zerohashes
 from . import impl
 from .typing import (
-    is_bool_type, is_bytes_type, is_bytesn_type, is_container_type,
-    is_list_kind, is_list_type, is_uint_type, is_vector_type, read_elem_type,
+    is_bool_type, is_bytesn_type, is_container_type, is_list_kind,
+    is_list_type, is_uint_type, is_vector_type, read_elem_type,
     uint_byte_size)
 
 # below this many 64-byte pair inputs, OpenSSL beats device dispatch

@@ -21,8 +21,8 @@ from typing import Any, List as PyList, Tuple
 from ..hash import sha256
 from ..merkle import merkleize_chunks
 from .typing import (
-    Bytes, Container, List, Vector, byte,
-    get_zero_value, infer_type, is_bool_type, is_bytes_type, is_bytesn_type,
+    Container,
+    infer_type, is_bool_type, is_bytes_type, is_bytesn_type,
     is_container_type, is_list_kind, is_list_type, is_uint_type,
     is_vector_kind, is_vector_type, read_elem_type, uint, uint_byte_size,
 )

@@ -13,7 +13,7 @@ full uint64 class instead of NewType, deserialization support).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List as PyList, Tuple, Type
+from typing import Any, Dict, List as PyList, Tuple
 
 
 # ---------------------------------------------------------------------------

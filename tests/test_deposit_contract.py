@@ -1,0 +1,143 @@
+"""Deposit contract model vs the consensus-side SSZ Merkleizer.
+
+The reference cross-validates its EVM contract against pyspec's
+hash_tree_root(DepositData) on an in-process chain
+(/root/reference deposit_contract/tests/contracts/test_deposit.py);
+here the same differential runs between the contract state machine and
+the framework's generic SSZ machinery + DepositTree test factory.
+"""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.deposit_contract import DepositContract
+from consensus_specs_tpu.deposit_contract.contract import (
+    CHAIN_START_FULL_DEPOSIT_THRESHOLD, FULL_DEPOSIT_GWEI, MIN_DEPOSIT_GWEI,
+    deposit_data_root)
+from consensus_specs_tpu.models import phase0
+from consensus_specs_tpu.testing import factories as f
+from consensus_specs_tpu.utils.merkle import get_merkle_root
+from consensus_specs_tpu.utils.ssz.impl import hash_tree_root
+
+SPEC = phase0.get_spec("minimal")
+
+
+def _args(i, amount=FULL_DEPOSIT_GWEI):
+    return dict(
+        pubkey=bytes([i]) * 48,
+        withdrawal_credentials=bytes([i + 1]) * 32,
+        signature=bytes([i + 2]) * 96,
+        value_gwei=amount,
+    )
+
+
+def test_leaf_matches_ssz_hash_tree_root():
+    """The contract's hand-rolled DepositData root == generic SSZ."""
+    for i in range(5):
+        a = _args(i, amount=MIN_DEPOSIT_GWEI + i)
+        data = SPEC.DepositData(
+            pubkey=a["pubkey"],
+            withdrawal_credentials=a["withdrawal_credentials"],
+            amount=a["value_gwei"],
+            signature=a["signature"],
+        )
+        assert deposit_data_root(a["pubkey"], a["withdrawal_credentials"],
+                                 a["value_gwei"], a["signature"]) \
+            == hash_tree_root(data, SPEC.DepositData)
+
+
+@pytest.mark.parametrize("count", [1, 2, 3, 7, 10])
+def test_incremental_root_matches_full_tree(count):
+    """O(log n) branch accumulation == recomputing the whole padded tree."""
+    contract = DepositContract()
+    leaves = []
+    for i in range(count):
+        a = _args(i)
+        contract.deposit(**a)
+        leaves.append(deposit_data_root(
+            a["pubkey"], a["withdrawal_credentials"], a["value_gwei"],
+            a["signature"]))
+        assert contract.get_deposit_root() == \
+            get_merkle_root(leaves, pad_to=2 ** 32)
+    assert contract.get_deposit_count() == count.to_bytes(8, "little")
+
+
+def test_contract_deposits_process_on_chain():
+    """e2e: a deposit made through the contract model is accepted by
+    process_deposit against the contract's own root."""
+    bls.bls_active = False
+    state = f.seed_genesis_state(SPEC, SPEC.SLOTS_PER_EPOCH * 8)
+    contract = DepositContract()
+
+    # replay the registry's existing deposits as contract zero-leaves is
+    # not possible (the mock genesis has none); start a fresh eth1 view
+    state.deposit_index = 0
+    newcomer = len(state.validator_registry)
+    data = f.deposit_payload(SPEC, newcomer, FULL_DEPOSIT_GWEI)
+    contract.deposit(
+        pubkey=bytes(data.pubkey),
+        withdrawal_credentials=bytes(data.withdrawal_credentials),
+        signature=bytes(data.signature),
+        value_gwei=int(data.amount),
+    )
+    state.latest_eth1_data.deposit_root = contract.get_deposit_root()
+    state.latest_eth1_data.deposit_count = contract.deposit_count
+
+    tree = f.DepositTree(SPEC, [])
+    deposit = SPEC.Deposit(
+        proof=list(tree.proof_of(tree.append(data))),
+        data=data,
+    )
+    SPEC.process_deposit(state, deposit)
+    assert len(state.validator_registry) == newcomer + 1
+    assert state.validator_registry[newcomer].pubkey == data.pubkey
+
+
+def test_rejects_malformed_deposits():
+    contract = DepositContract()
+    good = _args(0)
+    with pytest.raises(AssertionError):
+        contract.deposit(**{**good, "pubkey": b"\x00" * 47})
+    with pytest.raises(AssertionError):
+        contract.deposit(**{**good, "withdrawal_credentials": b"\x00" * 31})
+    with pytest.raises(AssertionError):
+        contract.deposit(**{**good, "signature": b"\x00" * 95})
+    with pytest.raises(AssertionError):
+        contract.deposit(**{**good, "value_gwei": MIN_DEPOSIT_GWEI - 1})
+    assert contract.deposit_count == 0
+
+
+def test_eth2genesis_fires_at_threshold(monkeypatch):
+    import consensus_specs_tpu.deposit_contract.contract as c
+    monkeypatch.setattr(c, "CHAIN_START_FULL_DEPOSIT_THRESHOLD", 3)
+    contract = DepositContract()
+    events = []
+    for i in range(3):
+        events.append(contract.deposit(**_args(i), timestamp=1_700_000_123))
+    assert events[:2] == [None, None]
+    genesis = events[2]
+    assert contract.chain_started
+    assert genesis.deposit_root == contract.get_deposit_root()
+    assert genesis.deposit_count == (3).to_bytes(8, "little")
+    t = int.from_bytes(genesis.time, "little")
+    assert t % 86400 == 0 and t > 1_700_000_123
+
+
+def test_partial_deposits_do_not_count_toward_genesis(monkeypatch):
+    import consensus_specs_tpu.deposit_contract.contract as c
+    monkeypatch.setattr(c, "CHAIN_START_FULL_DEPOSIT_THRESHOLD", 2)
+    contract = DepositContract()
+    assert contract.deposit(**_args(0, amount=MIN_DEPOSIT_GWEI)) is None
+    assert contract.deposit(**_args(1, amount=MIN_DEPOSIT_GWEI)) is None
+    assert not contract.chain_started
+    assert contract.deposit(**_args(2)) is None   # first FULL deposit
+    assert contract.deposit(**_args(3)) is not None
+    assert contract.chain_started
+
+
+def test_deposit_events_logged():
+    contract = DepositContract()
+    contract.deposit(**_args(5))
+    (event,) = contract.logs
+    assert event.pubkey == bytes([5]) * 48
+    assert event.merkle_tree_index == (0).to_bytes(8, "little")
+    assert event.amount == FULL_DEPOSIT_GWEI.to_bytes(8, "little")

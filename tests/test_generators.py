@@ -12,7 +12,7 @@ import os
 import yaml
 
 from consensus_specs_tpu.generators import suites
-from consensus_specs_tpu.generators.base import Suite, run_generator, write_suite
+from consensus_specs_tpu.generators.base import run_generator, write_suite
 from consensus_specs_tpu.generators.from_tables import cases_from_table, table
 
 

@@ -1,0 +1,141 @@
+"""Light-client multiproofs: generalized indices, proofs, partials.
+
+Contract: /root/reference specs/light_client/merkle_proofs.md. Every proof
+here is cross-checked two ways: the prover's node map must agree with the
+recursive hash_tree_root at the root, and tampered leaves/proofs must fail
+verification.
+"""
+from random import Random
+
+import pytest
+
+from consensus_specs_tpu.light_client import (
+    MerklePartial, SSZMerkleTree, generalized_index_for_path,
+    get_helper_indices, merkle_tree_nodes, verify_multiproof)
+from consensus_specs_tpu.light_client.multiproof import LENGTH_FLAG, object_tree
+from consensus_specs_tpu.models import phase0
+from consensus_specs_tpu.testing import factories as f
+from consensus_specs_tpu.utils.hash import sha256
+from consensus_specs_tpu.utils.ssz.impl import hash_tree_root
+from consensus_specs_tpu.utils.ssz.typing import (
+    Bytes32, Container, List as SSZList, Vector, uint64)
+
+SPEC = phase0.get_spec("minimal")
+
+
+def test_merkle_tree_nodes_structure():
+    leaves = [bytes([i]) * 32 for i in range(4)]
+    nodes = merkle_tree_nodes(leaves)
+    assert nodes[4] == leaves[0] and nodes[7] == leaves[3]
+    assert nodes[2] == sha256(leaves[0] + leaves[1])
+    assert nodes[1] == sha256(nodes[2] + nodes[3])
+
+
+def test_single_leaf_proof_roundtrip():
+    leaves = [bytes([i]) * 32 for i in range(8)]
+    nodes = merkle_tree_nodes(leaves)
+    for gidx in (8, 11, 15):
+        helpers = get_helper_indices([gidx])
+        proof = [nodes[i] for i in helpers]
+        assert verify_multiproof(nodes[1], [gidx], [nodes[gidx]], proof)
+        assert not verify_multiproof(nodes[1], [gidx], [b"\xff" * 32], proof)
+
+
+def test_multiproof_smaller_than_separate_proofs():
+    leaves = [bytes([i]) * 32 for i in range(8)]
+    nodes = merkle_tree_nodes(leaves)
+    indices = [8, 9, 14]   # the spec's worked example (:121-130)
+    helpers = get_helper_indices(indices)
+    assert len(helpers) == 3   # vs 9 for three separate depth-3 proofs
+    proof = [nodes[i] for i in helpers]
+    assert verify_multiproof(nodes[1], indices, [nodes[i] for i in indices], proof)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_multiproofs(seed):
+    rng = Random(seed)
+    n = 16
+    leaves = [bytes(rng.randrange(256) for _ in range(32)) for _ in range(n)]
+    nodes = merkle_tree_nodes(leaves)
+    k = rng.randrange(1, 6)
+    indices = rng.sample(range(n, 2 * n), k)
+    helpers = get_helper_indices(indices)
+    proof = [nodes[i] for i in helpers]
+    values = [nodes[i] for i in indices]
+    assert verify_multiproof(nodes[1], indices, values, proof)
+    # corrupt one proof node
+    if proof:
+        bad = list(proof)
+        bad[0] = b"\x00" * 32 if bad[0] != b"\x00" * 32 else b"\x01" * 32
+        assert not verify_multiproof(nodes[1], indices, values, bad)
+
+
+class Inner(Container):
+    w: uint64
+    r: Bytes32
+
+
+class Demo(Container):
+    x: uint64
+    y: SSZList[uint64]
+    vec: Vector[Inner, 2]
+
+
+def _demo():
+    return Demo(x=7, y=[5, 6, 7],
+                vec=Vector[Inner, 2]([Inner(w=1, r=b"\xaa" * 32),
+                                      Inner(w=2, r=b"\xbb" * 32)]))
+
+
+def test_object_tree_root_matches_htr():
+    obj = _demo()
+    nodes = object_tree(obj, Demo)
+    assert nodes[1] == hash_tree_root(obj, Demo)
+
+
+def test_path_indices_resolve_to_correct_nodes():
+    obj = _demo()
+    tree = SSZMerkleTree(obj, Demo)
+
+    gx = generalized_index_for_path(obj, Demo, ["x"])
+    assert tree.nodes[gx] == (7).to_bytes(8, "little") + b"\x00" * 24
+
+    glen = generalized_index_for_path(obj, Demo, ["y", LENGTH_FLAG])
+    assert tree.nodes[glen] == (3).to_bytes(32, "little")
+
+    gy0 = generalized_index_for_path(obj, Demo, ["y", 0])
+    chunk = tree.nodes[gy0]
+    assert chunk[:8] == (5).to_bytes(8, "little")
+
+    gw = generalized_index_for_path(obj, Demo, ["vec", 1, "w"])
+    assert tree.nodes[gw] == (2).to_bytes(8, "little") + b"\x00" * 24
+
+
+def test_partial_proves_paths_against_state_root():
+    obj = _demo()
+    tree = SSZMerkleTree(obj, Demo)
+    indices = [
+        generalized_index_for_path(obj, Demo, ["x"]),
+        generalized_index_for_path(obj, Demo, ["y", LENGTH_FLAG]),
+        generalized_index_for_path(obj, Demo, ["vec", 0, "r"]),
+    ]
+    partial = tree.prove(indices)
+    assert partial.verify()
+    assert partial.value_at(indices[2]) == b"\xaa" * 32
+    # against the wrong root it must fail
+    assert not MerklePartial(b"\x42" * 32, partial.indices, partial.values,
+                             partial.proof).verify()
+
+
+def test_beacon_state_field_proof():
+    """A light client authenticates finalized_epoch against the state root."""
+    from consensus_specs_tpu.crypto import bls
+    bls.bls_active = False
+    state = f.seed_genesis_state(SPEC, SPEC.SLOTS_PER_EPOCH * 8)
+    state.finalized_epoch = 9
+    tree = SSZMerkleTree(state, SPEC.BeaconState)
+    gidx = generalized_index_for_path(state, SPEC.BeaconState, ["finalized_epoch"])
+    partial = tree.prove([gidx])
+    assert partial.verify()
+    assert int.from_bytes(partial.value_at(gidx)[:8], "little") == 9
+    assert tree.root == hash_tree_root(state, SPEC.BeaconState)

@@ -14,14 +14,13 @@ import pytest
 from consensus_specs_tpu.debug.random_value import (
     RandomizationMode, get_mode_by_name, get_random_ssz_object)
 from consensus_specs_tpu.models import phase0
-from consensus_specs_tpu.models.phase0 import containers
 from consensus_specs_tpu.utils.ssz.impl import (
     deserialize, hash_tree_root, serialize)
 from consensus_specs_tpu.utils.ssz.typing import (
     Bytes32, List as SSZList, Vector, uint8, uint16, uint64, uint256)
 
 SPEC = phase0.get_spec("minimal")
-CONTAINER_NAMES = sorted(containers.build_types(SPEC).keys())
+CONTAINER_NAMES = sorted(SPEC.container_types.keys())
 
 
 @pytest.mark.parametrize("mode", list(RandomizationMode))

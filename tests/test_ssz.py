@@ -10,7 +10,7 @@ import hashlib
 import pytest
 
 from consensus_specs_tpu.utils.ssz import (
-    Bytes32, Bytes48, Bytes96, Container, List, Vector,
+    Bytes32, Bytes96, Container, List, Vector,
     uint8, uint16, uint32, uint64, uint128, uint256,
     serialize, deserialize, hash_tree_root, signing_root,
     get_zero_value, is_fixed_size,

@@ -1,0 +1,122 @@
+"""Honest-validator duties: assignments, proposals, attesting, protection.
+
+Contract: /root/reference specs/validator/0_beacon-chain-validator.md
+(:133-158 assignments, :182-276 proposal construction, :278-361
+attestation construction, :363-389 slashing protection).
+"""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.models import phase0
+from consensus_specs_tpu.models.phase0.validator import SlashingProtection
+from consensus_specs_tpu.testing import factories as f
+from consensus_specs_tpu.testing.keys import privkeys
+from consensus_specs_tpu.utils.ssz.impl import signing_root
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return phase0.get_spec("minimal")
+
+
+@pytest.fixture(autouse=True)
+def _bls_off():
+    old = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = old
+
+
+@pytest.fixture()
+def state(spec):
+    return f.seed_genesis_state(spec, spec.SLOTS_PER_EPOCH * 8)
+
+
+def test_every_active_validator_has_an_assignment(spec, state):
+    epoch = spec.get_current_epoch(state)
+    seen_slots = set()
+    for index in spec.get_active_validator_indices(state, epoch):
+        assignment = spec.get_committee_assignment(state, epoch, index)
+        assert assignment is not None
+        committee, shard, slot = assignment
+        assert index in committee
+        assert spec.get_epoch_start_slot(epoch) <= slot \
+            < spec.get_epoch_start_slot(epoch) + spec.SLOTS_PER_EPOCH
+        assert committee == spec.get_crosslink_committee(state, epoch, shard)
+        seen_slots.add(slot)
+    assert len(seen_slots) >= 1
+
+
+def test_next_epoch_assignment_allowed_future_rejected(spec, state):
+    epoch = spec.get_current_epoch(state)
+    assert spec.get_committee_assignment(state, epoch + 1, 0) is not None
+    with pytest.raises(AssertionError):
+        spec.get_committee_assignment(state, epoch + 2, 0)
+
+
+def test_exactly_one_proposer_per_slot(spec, state):
+    f.advance_slots(spec, state)
+    epoch = spec.get_current_epoch(state)
+    active = spec.get_active_validator_indices(state, epoch)
+    proposers = [i for i in active if spec.is_proposer(state, i)]
+    assert len(proposers) == 1
+
+
+def test_build_proposal_transitions_cleanly(spec, state):
+    f.advance_slots(spec, state)
+    proposer = spec.get_beacon_proposer_index(state)
+    parent_root = signing_root(state.latest_block_header) \
+        if state.latest_block_header.state_root != spec.ZERO_HASH \
+        else f.empty_block(spec, state).parent_root
+    block = spec.build_proposal(state, state.slot, parent_root,
+                                privkeys[proposer])
+    spec.state_transition(state, block)
+    assert state.slot == block.slot
+
+
+def test_attestation_duty_is_processable(spec, state):
+    state.slot = spec.SLOTS_PER_EPOCH  # off the genesis boundary
+    epoch = spec.get_current_epoch(state)
+    index = spec.get_active_validator_indices(state, epoch)[0]
+    committee, shard, slot = spec.get_committee_assignment(state, epoch, index)
+
+    spec.process_slots(state, slot) if slot > state.slot else None
+    head_root = f.empty_block_next(spec, state).parent_root
+    att = spec.build_attestation_duty(
+        state, head_root, committee, shard, index, privkeys[index])
+
+    # single-bit participation, as the guide requires
+    attesters = spec.get_attesting_indices(state, att.data, att.aggregation_bitfield)
+    assert attesters == [index]
+
+    # and the produced attestation passes process_attestation
+    state.slot = max(state.slot, slot) + spec.MIN_ATTESTATION_INCLUSION_DELAY
+    spec.process_attestation(state, att)
+
+
+def test_eth1_vote_majority(spec, state):
+    a = spec.Eth1Data(deposit_root=b"\x01" * 32, deposit_count=1, block_hash=b"\x02" * 32)
+    b = spec.Eth1Data(deposit_root=b"\x03" * 32, deposit_count=2, block_hash=b"\x04" * 32)
+    state.eth1_data_votes = [a, b, b]
+    assert spec.get_eth1_vote(state) == b
+    state.eth1_data_votes = []
+    assert spec.get_eth1_vote(state) == state.latest_eth1_data
+    assert spec.get_eth1_vote(state, known_eth1_data=a) == a
+
+
+def test_slashing_protection_blocks_double_proposal():
+    db = SlashingProtection()
+    db.record_proposal(5, 100)
+    assert not db.may_propose(5, 100)
+    assert db.may_propose(5, 101)
+    assert db.may_propose(6, 100)
+
+
+def test_slashing_protection_blocks_double_and_surround_votes():
+    db = SlashingProtection()
+    db.record_attestation(1, source_epoch=2, target_epoch=4)
+    assert not db.may_attest(1, 3, 4)     # double vote at target 4
+    assert not db.may_attest(1, 1, 5)     # would surround (1,5) around (2,4)
+    assert not db.may_attest(1, 3, 3.5)   # hypothetical inner: surrounded
+    assert db.may_attest(1, 4, 5)         # clean successive vote
+    assert db.may_attest(2, 2, 4)         # other validator unaffected

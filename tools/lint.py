@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Self-contained lint gate (no third-party linters in the image).
+
+Checks, per Python file: parses (SyntaxError = fail), no tabs in
+indentation, no trailing whitespace, lines <= 120 columns (the reference
+lints at 120, Makefile:60-62), and module-level imports that are never
+referenced (AST-based, conservative: skips __init__.py re-exports and
+imports marked `# noqa`).
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+MAX_COLS = 120
+
+
+def iter_py_files(targets):
+    for target in targets:
+        path = Path(target)
+        if path.is_dir():
+            yield from sorted(path.rglob("*.py"))
+        elif path.suffix == ".py":
+            yield path
+
+
+def unused_imports(tree: ast.AST, source: str, is_init: bool):
+    if is_init:
+        return []
+    lines = source.splitlines()
+    imported = {}   # name -> (lineno, shown_as)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                imported[name] = (node.lineno, alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                name = alias.asname or alias.name
+                imported[name] = (node.lineno, alias.name)
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+    out = []
+    for name, (lineno, shown) in imported.items():
+        if name in used:
+            continue
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        if "noqa" in line:
+            continue
+        # names can appear in docstring doctests or __all__ strings
+        if f'"{name}"' in source or f"'{name}'" in source:
+            continue
+        out.append((lineno, f"unused import: {shown}"))
+    return out
+
+
+def lint_file(path: Path):
+    problems = []
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:
+        return [(exc.lineno or 0, f"syntax error: {exc.msg}")]
+    for i, line in enumerate(source.splitlines(), 1):
+        stripped = line.rstrip("\n")
+        indent = stripped[:len(stripped) - len(stripped.lstrip("\t \x0c"))]
+        if "\t" in indent:
+            problems.append((i, "tab in indentation"))
+        if stripped != stripped.rstrip():
+            problems.append((i, "trailing whitespace"))
+        if len(stripped) > MAX_COLS:
+            problems.append((i, f"line too long ({len(stripped)} > {MAX_COLS})"))
+    problems.extend(unused_imports(tree, source, path.name == "__init__.py"))
+    return problems
+
+
+def main(argv):
+    failed = False
+    count = 0
+    for path in iter_py_files(argv or ["."]):
+        count += 1
+        for lineno, message in lint_file(path):
+            print(f"{path}:{lineno}: {message}")
+            failed = True
+    print(f"lint: {count} files checked", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
