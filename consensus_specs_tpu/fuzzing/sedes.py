@@ -137,6 +137,10 @@ class HomogeneousList(Sedes):
         if not data:
             return []
         first = int.from_bytes(data[:OFFSET_WIDTH], "little")
+        if first > len(data):
+            # bound BEFORE deriving count: a 4-byte hostile offset would
+            # otherwise size a ~2^30-entry member list pre-validation
+            raise ValueError("first offset beyond input")
         if first % OFFSET_WIDTH:
             raise ValueError("misaligned offset table")
         count = first // OFFSET_WIDTH

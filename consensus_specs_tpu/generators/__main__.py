@@ -4,7 +4,7 @@
 
 Equivalent of the reference's `make gen_yaml_tests` (Makefile:43,87-104),
 in one process. Families: operations, epoch_processing, sanity, shuffling,
-bls, ssz_static.
+bls, ssz_static, ssz_generic.
 """
 from __future__ import annotations
 
@@ -21,6 +21,7 @@ FAMILIES = {
     "shuffling": lambda: [suites.shuffling_suite],
     "bls": suites.bls_creators,
     "ssz_static": lambda: [suites.ssz_static_suite],
+    "ssz_generic": lambda: [suites.ssz_generic_suite],
 }
 
 

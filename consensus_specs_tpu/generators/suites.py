@@ -241,10 +241,74 @@ def ssz_static_suite(preset: str) -> Suite:
 
 
 # ---------------------------------------------------------------------------
+# ssz_generic: atomic uint valid/invalid vectors
+# (reference: test_generators/ssz_generic/uint_test_cases.py — random /
+#  wrong-length / bounds / out-of-bounds cases over the 6 uint widths)
+# ---------------------------------------------------------------------------
+
+_UINT_BIT_SIZES = [8, 16, 32, 64, 128, 256]
+
+
+def _uint_case(byte_len: int, *, value=None, serial=None, valid: bool,
+               tags) -> dict:
+    from ..fuzzing.sedes import UInt
+    sedes = UInt(byte_len)
+    case = {"type": f"uint{byte_len * 8}", "valid": valid,
+            "tags": list(tags)}
+    if valid:
+        case["value"] = str(value)
+        case["ssz"] = "0x" + sedes.encode(value).hex()
+    else:
+        case["ssz"] = "0x" + serial.hex()
+    return case
+
+
+def ssz_generic_suite(preset: str) -> Suite:
+    """Atomic uint vectors — uniform random values, exact bounds, and
+    invalid serializations (wrong length / out-of-range decimal), encoded
+    by the independent sedes codec so the main SSZ stack can be diffed
+    against it (format: specs/test_formats/ssz_generic/uint.md)."""
+    if preset != "mainnet":
+        return None  # wire format has no preset dependence; emit once
+    rng = Random(1109)
+    cases: List[dict] = []
+    for bits in _UINT_BIT_SIZES:
+        blen = bits // 8
+        for _ in range(8):
+            cases.append(_uint_case(
+                blen, value=rng.randrange(2 ** bits), valid=True,
+                tags=("atomic", "uint", "random")))
+        for value, tag in ((0, "uint_lower_bound"),
+                           (2 ** bits - 1, "uint_upper_bound")):
+            cases.append(_uint_case(blen, value=value, valid=True,
+                                    tags=("atomic", "uint", tag)))
+        for length in sorted({0, blen // 2, blen - 1, blen + 1, blen * 2}):
+            if length == blen:
+                continue
+            serial = bytes(rng.randrange(256) for _ in range(length))
+            cases.append(_uint_case(blen, serial=serial, valid=False,
+                                    tags=("atomic", "uint", "wrong_length")))
+        # out-of-range values expressed as decimal (no valid serialization)
+        for value, tag in ((2 ** bits, "uint_overflow"), (-1, "uint_underflow")):
+            cases.append({"type": f"uint{bits}", "valid": False,
+                          "value": str(value),
+                          "tags": ["atomic", "uint", tag]})
+    return Suite(
+        title="SSZ generic uint",
+        summary="Atomic uint valid/invalid wire vectors from the "
+                "independent sedes codec",
+        config="mainnet",
+        runner="ssz_generic",
+        handler="uint",
+        test_cases=cases,
+    )
+
+
+# ---------------------------------------------------------------------------
 # Registry of every family (the `make gen_yaml_tests` equivalent)
 # ---------------------------------------------------------------------------
 
 def all_creators():
     return (operations_creators() + epoch_processing_creators()
             + sanity_creators() + [shuffling_suite] + bls_creators()
-            + [ssz_static_suite])
+            + [ssz_static_suite, ssz_generic_suite])

@@ -1,0 +1,224 @@
+"""Light-client sync protocol: period data, committee reconstruction, and
+block-validity proofs.
+
+Contract: /root/reference specs/light_client/sync_protocol.md — expansions
+and `PeriodData` :28-66, period-start epochs :68-80, `get_period_data`
+:82-96, light-client state (`ValidatorMemory`) :98-106, committee update
+cadence and proof-size budget :108-117 (~38 bytes/epoch amortized),
+`compute_committee` :119-160, `BlockValidityProof` +
+`verify_block_validity_proof` :164-199 (664-byte proof).
+
+Design notes (adaptation, not translation):
+- The reference doc predates its own shard-chain doc's committee helpers
+  and is internally inconsistent with it (e.g. `int_to_bytes(index,
+  length=3)` here vs `length=8` there). We make the light client
+  *internally consistent with our phase-1 shard module*: the committee a
+  light client reconstructs offline is bit-identical to
+  `get_persistent_committee` computed from the full state — asserted in
+  tests/test_light_client.py. That equality is the whole point of the
+  protocol: the client tracks a shard's persistent committee without the
+  registry.
+- `PeriodData.committee` stores the shard's full *span* of the period's
+  shuffle (the doc's "maximal committee"). The doc's key observation
+  (:162) — a shard's span boundaries are independent of committee_count
+  because `(n * shard * cc) // (SHARD_COUNT * cc) == n * shard //
+  SHARD_COUNT` — is what lets `compute_committee` re-slice the span with
+  a committee_count agreed between *two* periods that each only knew
+  their own count when the proof was built.
+- The pairing check in `verify_block_validity_proof` rides the same
+  backend boundary as everything else (`spec.bls`), so the TPU grouped
+  pairing verifies light-client proofs too.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence
+
+
+# ---------------------------------------------------------------------------
+# Period data
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PeriodData:
+    """What a light client retains about one persistent-committee period of
+    one shard (sync_protocol.md:57-66): enough to rebuild any slot's
+    committee slice without the validator registry."""
+    validator_count: int            # active validators at period start
+    seed: bytes                     # generate_seed(state, period_start)
+    committee: List[int]            # the shard's shuffle span, in shuffled order
+    validators: Dict[int, object]   # index -> Validator record (pubkey, balance)
+
+
+@dataclass
+class ValidatorMemory:
+    """Light-client state (sync_protocol.md:98-106). `fork_version` is the
+    client's own view of the chain's fork (learned when it synced its
+    finalized header) — domain separation must come from here, never from
+    the proof under verification."""
+    shard_id: int
+    finalized_header: object        # BeaconBlockHeader
+    earlier_period_data: PeriodData
+    later_period_data: PeriodData
+    fork_version: bytes = b"\x00\x00\x00\x00"
+
+
+def get_earlier_start_epoch(spec, slot: int) -> int:
+    epoch = spec.slot_to_epoch(slot)
+    return max(0, epoch - (epoch % spec.PERSISTENT_COMMITTEE_PERIOD)
+               - spec.PERSISTENT_COMMITTEE_PERIOD * 2)
+
+
+def get_later_start_epoch(spec, slot: int) -> int:
+    epoch = spec.slot_to_epoch(slot)
+    return max(0, epoch - (epoch % spec.PERSISTENT_COMMITTEE_PERIOD)
+               - spec.PERSISTENT_COMMITTEE_PERIOD)
+
+
+def _shard_span(spec, indices: List[int], seed: bytes,
+                shard: int) -> List[int]:
+    """The shard's contiguous span of the period's shuffled validator set
+    (concatenation of all its committee_count slices — boundaries are
+    committee_count-invariant, sync_protocol.md:162)."""
+    n = len(indices)
+    if n == 0:
+        return []
+    start = (n * shard) // spec.SHARD_COUNT
+    end = (n * (shard + 1)) // spec.SHARD_COUNT
+    perm = spec.get_shuffle_permutation(n, seed)
+    return [indices[perm[i]] for i in range(start, end)]
+
+
+def get_period_data(spec, state, slot: int, shard_id: int,
+                    later: bool) -> PeriodData:
+    """Extract one period's light-client data from a (full) state — the
+    server side of the protocol (sync_protocol.md:82-96). A production
+    server would ship this as a MerklePartial against the finalized state
+    root (light_client/multiproof.py); here the object itself is the
+    payload and the multiproof layer is orthogonal."""
+    period_start = (get_later_start_epoch(spec, slot) if later
+                    else get_earlier_start_epoch(spec, slot))
+    indices = spec.get_active_validator_indices(state, period_start)
+    seed = spec.generate_seed(state, period_start)
+    span = _shard_span(spec, indices, seed, shard_id)
+    return PeriodData(
+        validator_count=len(indices),
+        seed=seed,
+        committee=span,
+        validators={i: state.validator_registry[i] for i in span},
+    )
+
+
+# ---------------------------------------------------------------------------
+# Committee reconstruction (client side, no registry access)
+# ---------------------------------------------------------------------------
+
+def _slice_of_span(span: List[int], n: int, shard: int, shard_count: int,
+                   index: int, committee_count: int) -> List[int]:
+    """Slice `index` of the shard's `committee_count` slices, cut out of the
+    stored span by global shuffle offsets."""
+    span_start = (n * shard) // shard_count
+    lo = (n * (shard * committee_count + index)) // (shard_count * committee_count)
+    hi = (n * (shard * committee_count + index + 1)) // (shard_count * committee_count)
+    return span[lo - span_start:hi - span_start]
+
+
+def _switchover_epoch(spec, seed: bytes, index: int) -> int:
+    # Identical formula to models/phase1/shard.py:get_switchover_epoch so
+    # the reconstruction matches get_persistent_committee bit-for-bit.
+    mixed = spec.hash(seed + spec.int_to_bytes(index, length=8))
+    return spec.bytes_to_int(mixed[0:8]) % spec.PERSISTENT_COMMITTEE_PERIOD
+
+
+def compute_committee(spec, header, validator_memory: ValidatorMemory) -> List[int]:
+    """The persistent committee for the header's slot, rebuilt from the two
+    stored period datas alone (sync_protocol.md:119-160)."""
+    mem = validator_memory
+    earlier, later = mem.earlier_period_data, mem.later_period_data
+    epoch = spec.slot_to_epoch(header.slot)
+    period = spec.PERSISTENT_COMMITTEE_PERIOD
+
+    committee_count = max(
+        earlier.validator_count // (spec.SHARD_COUNT * spec.TARGET_COMMITTEE_SIZE),
+        later.validator_count // (spec.SHARD_COUNT * spec.TARGET_COMMITTEE_SIZE),
+    ) + 1
+    index = header.slot % committee_count
+
+    actual_earlier = _slice_of_span(
+        earlier.committee, earlier.validator_count, mem.shard_id,
+        spec.SHARD_COUNT, index, committee_count)
+    actual_later = _slice_of_span(
+        later.committee, later.validator_count, mem.shard_id,
+        spec.SHARD_COUNT, index, committee_count)
+
+    offset = epoch % period
+    members = set(
+        [i for i in actual_earlier
+         if offset < _switchover_epoch(spec, earlier.seed, i)]
+        + [i for i in actual_later
+           if offset >= _switchover_epoch(spec, earlier.seed, i)]
+    )
+    return sorted(members)
+
+
+# ---------------------------------------------------------------------------
+# Block validity proofs
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BlockValidityProof:
+    """664-byte proof that a header is attested by the tracked shard's
+    persistent committee (sync_protocol.md:168-175)."""
+    header: object                   # BeaconBlockHeader
+    shard_aggregate_signature: bytes
+    shard_bitfield: bytes
+    shard_parent_block: object       # ShardBlock
+
+
+def verify_block_validity_proof(spec, proof: BlockValidityProof,
+                                validator_memory: ValidatorMemory) -> bool:
+    """sync_protocol.md:179-197: anchor the shard block to the header,
+    check >50% committee balance support, verify the aggregate signature.
+    Returns False (never raises) on any failed check — the light client's
+    caller treats a bad proof as a peer failure, not a crash."""
+    mem = validator_memory
+    try:
+        assert bytes(proof.shard_parent_block.beacon_chain_root) == \
+            spec.signing_root(proof.header)
+        committee = compute_committee(spec, proof.header, mem)
+        assert committee, "empty committee"
+        assert spec.verify_bitfield(proof.shard_bitfield, len(committee))
+        records = {**mem.earlier_period_data.validators,
+                   **mem.later_period_data.validators}
+        support = total = 0
+        pubkeys = []
+        for i, vindex in enumerate(committee):
+            v = records[vindex]
+            total += v.effective_balance
+            if spec.get_bitfield_bit(proof.shard_bitfield, i) == 0b1:
+                support += v.effective_balance
+                pubkeys.append(v.pubkey)
+        assert support * 2 > total
+        domain = spec.bls_domain(spec.DOMAIN_SHARD_ATTESTER,
+                                 bytes(mem.fork_version))
+        assert spec.bls.bls_verify(
+            spec.bls.bls_aggregate_pubkeys(pubkeys),
+            spec.signing_root(proof.shard_parent_block),
+            bytes(proof.shard_aggregate_signature),
+            domain,
+        )
+        return True
+    except (AssertionError, KeyError, IndexError):
+        return False
+
+
+def build_validator_memory(spec, state, slot: int,
+                           shard_id: int, finalized_header) -> ValidatorMemory:
+    """Server-side convenience: the memory a client holds after syncing to
+    `finalized_header` (sync_protocol.md:98-106)."""
+    return ValidatorMemory(
+        shard_id=shard_id,
+        finalized_header=finalized_header,
+        earlier_period_data=get_period_data(spec, state, slot, shard_id, later=False),
+        later_period_data=get_period_data(spec, state, slot, shard_id, later=True),
+    )

@@ -102,18 +102,22 @@ def _padding_block_for_length(message_bytes: int) -> np.ndarray:
 _PAD_64 = _padding_block_for_length(64)  # padding block for 64-byte messages
 
 
-@jax.jit
-def sha256_pairs(words: jnp.ndarray) -> jnp.ndarray:
+def sha256_pairs_inner(words: jnp.ndarray) -> jnp.ndarray:
     """Hash N 64-byte messages given as [N, 16] uint32 (big-endian words) -> [N, 8].
 
     This is the Merkle work-horse: each lane is `sha256(left ‖ right)`.
     Two compressions: the data block, then the constant padding block.
+    Un-jitted so larger traced programs (merkle_reduce_words, the bulk
+    state-root) can inline it; sha256_pairs is the jitted entry point.
     """
     n = words.shape[0]
     state = jnp.broadcast_to(jnp.asarray(H0), (n, 8))
     state = sha256_blocks(state, words)
     pad = jnp.broadcast_to(jnp.asarray(_PAD_64), (n, 16))
     return sha256_blocks(state, pad)
+
+
+sha256_pairs = jax.jit(sha256_pairs_inner)
 
 
 @jax.jit
@@ -193,6 +197,52 @@ def _sha256_multiblock(words: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 # Device-side Merkle reduction
 # ---------------------------------------------------------------------------
+
+def _zerohash_words(depth: int) -> np.ndarray:
+    """[8] uint32 big-endian words of the depth-`depth` zero-subtree root."""
+    from ..utils.hash import zerohashes  # local import to avoid cycle
+    return bytes_to_words(np.frombuffer(zerohashes[depth], dtype=np.uint8))
+
+
+def merkle_reduce_words(chunks: jnp.ndarray) -> jnp.ndarray:
+    """[N, 8]-word chunk rows -> [8] root words, entirely on device.
+
+    Trace-time Python loop over levels (static unroll, log2(N) iterations);
+    odd levels are padded with the zero-subtree hash of that depth, which
+    is exactly SSZ merkleize's virtual zero-chunk padding
+    (specs/simple-serialize.md:139-147, merkle_minimal.py:47-54) without
+    materializing a power-of-two tree. Designed to be called INSIDE a jit:
+    the whole reduction — every level of a 1M-leaf tree — is one compiled
+    program, one transfer in, 32 bytes out. (The per-level host loop in
+    merkle_root_device round-trips device<->host each level; over the TPU
+    tunnel that is the difference between ~70 s and ~10 ms for a
+    1M-validator registry root.)
+    """
+    level = chunks
+    depth = 0
+    while level.shape[0] > 1:
+        if level.shape[0] % 2 == 1:
+            pad = jnp.asarray(_zerohash_words(depth))[None, :]
+            level = jnp.concatenate([level, pad], axis=0)
+        level = sha256_pairs_inner(level.reshape(-1, 16))
+        depth += 1
+    return level[0]
+
+
+def subtree_roots_words(leaves: jnp.ndarray) -> jnp.ndarray:
+    """[V, P, 8]-word per-element subtrees -> [V, 8] roots, on device.
+
+    P must be a power of two; all V subtrees descend one level per
+    compression call, each level one (V*P/2)-lane batch. Composable inside
+    jit (the bulk state-root program inlines this)."""
+    V, P, _ = leaves.shape
+    assert P & (P - 1) == 0, "pad element chunk count to a power of two"
+    level = leaves
+    while level.shape[1] > 1:
+        level = sha256_pairs_inner(
+            level.reshape(-1, 16)).reshape(V, level.shape[1] // 2, 8)
+    return level[:, 0, :]
+
 
 def merkle_root_device(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
     """Root of a power-of-two tree over [N, 8]-word leaves, N == 2**depth.

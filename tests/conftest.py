@@ -24,8 +24,10 @@ if os.environ.get("CSTPU_TEST_TPU") != "1":
 # Persistent compilation cache: the BLS pairing programs take ~1 min each to
 # compile on the CPU backend; caching them across pytest processes turns
 # repeat runs into millisecond cache hits.
-os.makedirs("/tmp/cstpu-xla-cache", exist_ok=True)
-jax.config.update("jax_compilation_cache_dir", "/tmp/cstpu-xla-cache")
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "..", ".cache", "xla")
+os.makedirs(_CACHE_DIR, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import pytest  # noqa: E402

@@ -123,3 +123,36 @@ def test_pending_attestations_fall_back_correctly():
         for _ in range(5)
     ]
     assert bulk.hash_tree_root_bulk(atts, typ) == hash_tree_root(atts, typ)
+
+
+@pytest.mark.parametrize("V", [1, 5, 64, 257, 1000])
+def test_device_resident_roots_match_numpy_path(V):
+    """The one-program device path (leaf build + all Merkle levels traced
+    together) is bit-identical to the per-level numpy path — and therefore
+    to the recursive object oracle — including non-pow2 odd-level
+    padding."""
+    rng = np.random.default_rng(V)
+    pk = rng.integers(0, 256, (V, 48), dtype=np.uint8)
+    wc = rng.integers(0, 256, (V, 32), dtype=np.uint8)
+    e1 = rng.integers(0, 2 ** 63, V).astype(np.uint64)
+    e2 = rng.integers(0, 2 ** 63, V).astype(np.uint64)
+    e3 = np.full(V, 2 ** 64 - 1, np.uint64)   # FAR_FUTURE_EPOCH
+    e4 = rng.integers(0, 2 ** 63, V).astype(np.uint64)
+    sl = rng.integers(0, 2, V).astype(bool)
+    eb = rng.integers(0, 2 ** 35, V).astype(np.uint64)
+    bal = rng.integers(0, 2 ** 35, V).astype(np.uint64)
+    r1_dev, r2_dev = bulk.registry_and_balances_roots_device(
+        pk, wc, e1, e2, e3, e4, sl, eb, bal)
+    assert r1_dev == bulk.validator_registry_root_from_columns(
+        pk, wc, e1, e2, e3, e4, sl, eb)
+    assert r2_dev == bulk.uint64_list_root_from_column(bal)
+
+
+def test_device_resident_roots_empty_columns():
+    r1, r2 = bulk.registry_and_balances_roots_device(
+        np.zeros((0, 48), np.uint8), np.zeros((0, 32), np.uint8),
+        np.zeros(0, np.uint64), np.zeros(0, np.uint64),
+        np.zeros(0, np.uint64), np.zeros(0, np.uint64),
+        np.zeros(0, bool), np.zeros(0, np.uint64), np.zeros(0, np.uint64))
+    assert r1 == hash_tree_root([], SSZList[SPEC.Validator])
+    assert r2 == hash_tree_root([], SSZList[uint64])

@@ -74,3 +74,12 @@ def test_uint_bounds_and_bool_strictness():
         UInt(8).decode(b"\x00" * 7)
     with pytest.raises(ValueError):
         Boolean().decode(b"\x02")
+
+
+def test_hostile_first_offset_rejected_cheaply():
+    """A 4-byte input whose offset implies ~2^30 elements must fail the
+    bounds check before any count-sized allocation."""
+    from consensus_specs_tpu.fuzzing.sedes import HomogeneousList, UInt
+    lst = HomogeneousList(UInt(8))
+    with pytest.raises(ValueError):
+        lst.decode(b"\xfc\xff\xff\xff")

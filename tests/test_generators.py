@@ -79,3 +79,37 @@ def test_dry_run_writes_nothing(tmp_path, capsys):
     run_generator("shuffling", [suites.shuffling_suite],
                   argv=["-o", str(tmp_path), "-p", "minimal", "--dry"])
     assert not os.path.exists(os.path.join(str(tmp_path), "tests"))
+
+
+def test_ssz_generic_uint_suite_diffs_against_main_stack():
+    """Every valid uint case must decode+re-encode identically through the
+    MAIN SSZ stack (utils/ssz), not just the sedes codec that emitted it —
+    the differential purpose of ssz_generic vectors."""
+    from consensus_specs_tpu.utils.ssz import impl, typing as st
+
+    suite = suites.ssz_generic_suite("mainnet")
+    assert suite is not None and suites.ssz_generic_suite("minimal") is None
+    widths = {c["type"] for c in suite.test_cases}
+    assert widths == {f"uint{b}" for b in (8, 16, 32, 64, 128, 256)}
+    uint_by_bits = {8: st.uint8, 16: st.uint16, 32: st.uint32,
+                    64: st.uint64, 128: st.uint128, 256: st.uint256}
+    n_valid = n_invalid = 0
+    for c in suite.test_cases:
+        bits = int(c["type"][4:])
+        typ = uint_by_bits[bits]
+        if c["valid"]:
+            n_valid += 1
+            raw = bytes.fromhex(c["ssz"][2:])
+            assert len(raw) == bits // 8
+            value = int(c["value"])
+            assert impl.serialize(value, typ) == raw
+            assert impl.deserialize(raw, typ) == value
+        else:
+            n_invalid += 1
+            if "ssz" in c:
+                raw = bytes.fromhex(c["ssz"][2:])
+                assert len(raw) != bits // 8
+            else:
+                v = int(c["value"])
+                assert v < 0 or v >= 2 ** bits
+    assert n_valid >= 60 and n_invalid >= 36
