@@ -1,0 +1,10 @@
+"""In-process beacon-node API for validator clients.
+
+Executable model of the reference's OpenAPI surface
+(/root/reference specs/validator/beacon_node_oapi.yaml,
+specs/validator/0_beacon-node-validator-api.md): the endpoints a validator
+client needs, served straight off a (spec, state) pair with no HTTP stack —
+transport is someone else's problem, the contract (paths, inputs, outputs,
+error semantics) is modeled here and driven by tests.
+"""
+from .beacon_node import ApiError, BeaconNodeAPI, SyncingStatus  # noqa: F401

@@ -1,0 +1,201 @@
+"""The validator <-> beacon-node API, served in-process.
+
+Contract: /root/reference specs/validator/beacon_node_oapi.yaml —
+  /node/version        :15-29
+  /node/genesis_time   :30-45
+  /node/syncing        :46-66
+  /node/fork           :67-89
+  /validator/duties    :90-128   (per-pubkey proposal/attestation duties)
+  /validator/block     :129-186  (GET produce / POST publish)
+  /validator/attestation :187-250 (GET produce / POST publish)
+
+The OpenAPI error semantics map to ApiError(status): 400 invalid request,
+404 pubkey unknown, 406 duties cannot be served for the epoch, 503 while
+syncing (oapi.yaml's `beacon_node_task_error` / `pubkey_not_found`
+responses). Production/publishing delegates to the honest-validator duty
+builders (models/phase0/validator.py) and the state transition itself —
+the API layer adds only lookup, validation, and bookkeeping.
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+VERSION = "consensus-specs-tpu/0.3"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(message or f"HTTP {status}")
+        self.status = status
+
+
+@dataclass
+class SyncingStatus:
+    is_syncing: bool
+    starting_slot: int = 0
+    current_slot: int = 0
+    highest_slot: int = 0
+
+
+@dataclass
+class ValidatorDuty:
+    validator_pubkey: bytes
+    attestation_slot: int
+    attestation_shard: int
+    committee: List[int]
+    validator_index: int
+    block_proposal_slot: Optional[int] = None   # null unless proposing
+
+
+class BeaconNodeAPI:
+    """One node's view: a spec, its head state, and recent blocks."""
+
+    def __init__(self, spec, state, *, syncing: Optional[SyncingStatus] = None):
+        self.spec = spec
+        self.state = state
+        self.syncing = syncing or SyncingStatus(is_syncing=False)
+        self.published_blocks: List[object] = []
+        self.published_attestations: List[object] = []
+        self._pubkey_index: Dict[bytes, int] = {
+            bytes(v.pubkey): i
+            for i, v in enumerate(state.validator_registry)
+        }
+
+    # -- /node/* ------------------------------------------------------------
+
+    def get_version(self) -> str:
+        return VERSION
+
+    def get_genesis_time(self) -> int:
+        return int(self.state.genesis_time)
+
+    def get_syncing(self) -> SyncingStatus:
+        return self.syncing
+
+    def get_fork(self):
+        """-> (fork container, chain_id placeholder 0)."""
+        return self.state.fork, 0
+
+    # -- /validator/duties --------------------------------------------------
+
+    def get_validator_duties(self, validator_pubkeys: Sequence[bytes],
+                             epoch: Optional[int] = None) -> List[ValidatorDuty]:
+        self._reject_if_syncing()
+        spec, state = self.spec, self.state
+        epoch = spec.get_current_epoch(state) if epoch is None else int(epoch)
+        if abs(epoch - spec.get_current_epoch(state)) > 1:
+            raise ApiError(406, "duties only computable for adjacent epochs")
+        duties = []
+        for pubkey in validator_pubkeys:
+            index = self._pubkey_index.get(bytes(pubkey))
+            if index is None:
+                raise ApiError(404, "pubkey not found")
+            assignment = spec.get_committee_assignment(state, epoch, index)
+            if assignment is None:
+                raise ApiError(406, "no assignment in requested epoch")
+            committee, shard, slot = assignment
+            proposal_slot = None
+            if epoch == spec.get_current_epoch(state) and \
+                    state.slot >= spec.get_epoch_start_slot(epoch):
+                if spec.is_proposer(state, index):
+                    proposal_slot = int(state.slot)
+            duties.append(ValidatorDuty(
+                validator_pubkey=bytes(pubkey),
+                attestation_slot=int(slot),
+                attestation_shard=int(shard),
+                committee=[int(i) for i in committee],
+                validator_index=index,
+                block_proposal_slot=proposal_slot,
+            ))
+        return duties
+
+    # -- /validator/block ---------------------------------------------------
+
+    def produce_block(self, slot: int, randao_reveal: bytes):
+        """GET /validator/block: an unsigned proposal for `slot`. The
+        client signs it and POSTs it back (oapi.yaml:129-160)."""
+        self._reject_if_syncing()
+        spec, state = self.spec, self.state
+        if slot <= 0 or slot < state.slot:
+            raise ApiError(400, "cannot propose into the past")
+        parent_root = spec.signing_root(state.latest_block_header)
+        block = spec.BeaconBlock()
+        block.slot = int(slot)
+        block.parent_root = parent_root
+        block.body.randao_reveal = bytes(randao_reveal)
+        block.body.eth1_data = spec.get_eth1_vote(state)
+        scratch = deepcopy(state)
+        from ..crypto import bls
+        old = bls.bls_active
+        bls.bls_active = False
+        try:
+            spec.state_transition(scratch, block)
+            block.state_root = spec.hash_tree_root(scratch)
+        except (AssertionError, IndexError):
+            raise ApiError(400, "slot not reachable from head state")
+        finally:
+            bls.bls_active = old
+        return block
+
+    def publish_block(self, block) -> None:
+        """POST /validator/block: apply the signed block to the head state;
+        an invalid block is a 400, never a crash (oapi.yaml:161-186)."""
+        self._reject_if_syncing()
+        spec = self.spec
+        scratch = deepcopy(self.state)
+        try:
+            # a node accepting an external block verifies its claimed root
+            # (0_beacon-chain.md:1214-1216)
+            spec.state_transition(scratch, block, validate_state_root=True)
+        except (AssertionError, IndexError):
+            raise ApiError(400, "block failed state transition")
+        self.state = scratch
+        self._pubkey_index = {
+            bytes(v.pubkey): i
+            for i, v in enumerate(scratch.validator_registry)
+        }
+        self.published_blocks.append(block)
+
+    # -- /validator/attestation --------------------------------------------
+
+    def produce_attestation(self, validator_pubkey: bytes,
+                            slot: int, shard: int,
+                            poc_bit: int = 0):
+        """GET /validator/attestation: an unsigned single-bit attestation
+        for the validator's committee slot (oapi.yaml:187-221)."""
+        self._reject_if_syncing()
+        spec, state = self.spec, self.state
+        index = self._pubkey_index.get(bytes(validator_pubkey))
+        if index is None:
+            raise ApiError(404, "pubkey not found")
+        epoch = spec.slot_to_epoch(int(slot))
+        assignment = spec.get_committee_assignment(state, epoch, index)
+        if assignment is None or int(assignment[1]) != int(shard):
+            raise ApiError(400, "validator not assigned to that shard")
+        committee = assignment[0]
+        from ..models.phase0.validator import build_attestation_duty
+        head_root = spec.signing_root(state.latest_block_header)
+        att = build_attestation_duty(
+            spec, state, head_root, committee, int(shard), index, privkey=None)
+        return att
+
+    def publish_attestation(self, attestation) -> None:
+        """POST /validator/attestation (oapi.yaml:222-250). Queued for the
+        next proposal rather than applied (process_attestation runs when a
+        block includes it)."""
+        self._reject_if_syncing()
+        spec, state = self.spec, self.state
+        try:
+            data_slot = spec.get_attestation_data_slot(state, attestation.data)
+            assert data_slot <= state.slot
+        except (AssertionError, IndexError):
+            raise ApiError(400, "malformed attestation")
+        self.published_attestations.append(attestation)
+
+    # -----------------------------------------------------------------------
+
+    def _reject_if_syncing(self) -> None:
+        if self.syncing.is_syncing:
+            raise ApiError(503, "beacon node is syncing")

@@ -116,9 +116,11 @@ def build_proposal(spec, state, slot: int, parent_root: bytes, privkey: int,
 
 def build_attestation_duty(spec, head_state, head_block_root: bytes,
                            committee: List[int], shard: int,
-                           validator_index: int, privkey: int):
+                           validator_index: int, privkey: Optional[int]):
     """The validator's single-bit attestation for its assigned (committee,
-    shard) at the head state's slot (:278-361)."""
+    shard) at the head state's slot (:278-361). privkey None returns the
+    attestation unsigned (the beacon-node API's produce path: the client
+    holds the key and signs, beacon_node_oapi.yaml /validator/attestation)."""
     epoch_start_slot = spec.get_epoch_start_slot(spec.get_current_epoch(head_state))
     if epoch_start_slot == head_state.slot:
         target_root = head_block_root
@@ -147,13 +149,16 @@ def build_attestation_duty(spec, head_state, head_block_root: bytes,
     position = committee.index(validator_index)
     bits[position // 8] |= 1 << (position % 8)
 
-    wrapped = spec.AttestationDataAndCustodyBit(data=data, custody_bit=False)
-    signature = spec.bls.bls_sign(
-        message_hash=spec.hash_tree_root(wrapped),
-        privkey=privkey,
-        domain=spec.get_domain(head_state, spec.DOMAIN_ATTESTATION,
-                               message_epoch=data.target_epoch),
-    )
+    if privkey is None:
+        signature = b"\x00" * 96
+    else:
+        wrapped = spec.AttestationDataAndCustodyBit(data=data, custody_bit=False)
+        signature = spec.bls.bls_sign(
+            message_hash=spec.hash_tree_root(wrapped),
+            privkey=privkey,
+            domain=spec.get_domain(head_state, spec.DOMAIN_ATTESTATION,
+                                   message_epoch=data.target_epoch),
+        )
     return spec.Attestation(
         aggregation_bitfield=bytes(bits),
         data=data,

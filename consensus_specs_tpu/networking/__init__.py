@@ -1,0 +1,29 @@
+"""Executable model of the Eth 2.0 networking specs.
+
+The reference's networking layer is paper-only (SURVEY.md §2a row
+"Networking") — four markdown documents and no code. Here each document is
+an executable module so the wire behavior is testable and the test
+framework can drive multi-node flows in-process:
+
+- messaging.py   — message envelope codec
+  (/root/reference specs/networking/messaging.md:21-45)
+- rpc.py         — RPC-over-stream request/response protocol + methods
+  (/root/reference specs/networking/rpc-interface.md:36-285)
+- gossip.py      — gossipsub parameters, topics, in-process router
+  (/root/reference specs/networking/libp2p-standardization.md:72-158)
+- identity.py    — node records, peer ids, multiaddrs
+  (/root/reference specs/networking/node-identification.md:11-27)
+
+No sockets: transport is an injectable byte-pipe abstraction (the
+in-process loopback used in tests mirrors how the rest of the framework
+treats multi-node work — offline, deterministic, vector-friendly).
+"""
+from .messaging import (  # noqa: F401
+    COMPRESSION_NONE, ENCODING_SSZ, MessageEnvelopeError, decode_message,
+    encode_message)
+from .identity import NodeRecord, multiaddr, peer_id  # noqa: F401
+from .gossip import (  # noqa: F401
+    GOSSIPSUB_PROTOCOL_ID, GossipParams, GossipRouter, TOPIC_BEACON_ATTESTATION,
+    TOPIC_BEACON_BLOCK, shard_attestation_topic, topic_hash)
+from .rpc import (  # noqa: F401
+    RPC_PROTOCOL_ID, Goodbye, Hello, RpcError, RpcNode, loopback_pair)

@@ -53,13 +53,38 @@ def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
     return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
 
 
-def sha256_blocks(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+def _unroll_for(lanes: int) -> bool:
+    """Pick the round structure for a compression over `lanes` lanes.
+
+    True = 64 statically-unrolled rounds (fastest on TPU: 3.9x, the whole
+    chain fuses, carries never touch HBM). False = lax.fori_loop rounds
+    (graph ~64x smaller). XLA:CPU is pinned to the fori form: its algebraic
+    simplifier falls into a circular rewrite loop on the unrolled rotate
+    chains (observed "ran for 50 runs on computation main", compile never
+    returns — with both or-of-shifts and add-of-shifts rotations), so
+    unrolling is reserved for the TPU, and only where the batch is wide
+    enough to pay for the bigger program.
+    """
+    return lanes >= _UNROLL_MIN_LANES and jax.default_backend() != "cpu"
+
+
+def sha256_blocks(state: jnp.ndarray, block: jnp.ndarray,
+                  unroll=None) -> jnp.ndarray:
     """One SHA-256 compression. state: [..., 8] uint32, block: [..., 16] uint32.
 
-    Rounds run under lax.fori_loop (loop-carried dependency chain — no
-    cross-round parallelism to lose), keeping the traced graph ~64x smaller
-    than a Python unroll; the batch dimension is where the VPU parallelism is.
+    unroll=True statically unrolls the 64 rounds with a rotating 16-word
+    schedule window: no [64, batch] schedule array is ever materialized and
+    XLA fuses the whole round chain, so the carries live in registers
+    instead of round-tripping HBM every round — measured 3.9x faster at 4M
+    lanes on the v5e (64 ms vs 249 ms). unroll=False keeps the fori_loop
+    form whose traced graph is ~64x smaller. Default None = _unroll_for:
+    unrolled on TPU for wide batches, fori on CPU (XLA:CPU simplifier bug)
+    and for narrow levels that can't saturate the VPU anyway.
     """
+    if unroll is None:
+        unroll = _unroll_for(int(np.prod(block.shape[:-1])))
+    if unroll:
+        return _sha256_blocks_unrolled(state, block)
     batch = block.shape[:-1]
     w = jnp.zeros((64,) + batch, dtype=jnp.uint32)
     w = w.at[:16].set(jnp.moveaxis(block, -1, 0))
@@ -89,6 +114,30 @@ def sha256_blocks(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
     return state + jnp.stack(out, axis=-1)
 
 
+def _sha256_blocks_unrolled(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    """Unrolled compression: rotating 16-word schedule window, 64 static
+    rounds — one fused kernel, minimal HBM traffic."""
+    w = [block[..., i] for i in range(16)]
+    a, b, c, d, e, f, g, h = (state[..., i] for i in range(8))
+    for i in range(64):
+        if i < 16:
+            wi = w[i]
+        else:
+            x = w[(i - 15) % 16]
+            y = w[(i - 2) % 16]
+            s0 = _rotr(x, 7) ^ _rotr(x, 18) ^ (x >> np.uint32(3))
+            s1 = _rotr(y, 17) ^ _rotr(y, 19) ^ (y >> np.uint32(10))
+            wi = w[i % 16] + s0 + w[(i - 7) % 16] + s1
+            w[i % 16] = wi
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + np.uint32(K[i]) + wi
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        a, b, c, d, e, f, g, h = t1 + S0 + maj, a, b, c, d + t1, e, f, g
+    return state + jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
+
+
 def _padding_block_for_length(message_bytes: int) -> np.ndarray:
     """The final all-padding block for a message that exactly fills prior blocks."""
     assert message_bytes % 64 == 0
@@ -102,7 +151,7 @@ def _padding_block_for_length(message_bytes: int) -> np.ndarray:
 _PAD_64 = _padding_block_for_length(64)  # padding block for 64-byte messages
 
 
-def sha256_pairs_inner(words: jnp.ndarray) -> jnp.ndarray:
+def sha256_pairs_inner(words: jnp.ndarray, unroll=None) -> jnp.ndarray:
     """Hash N 64-byte messages given as [N, 16] uint32 (big-endian words) -> [N, 8].
 
     This is the Merkle work-horse: each lane is `sha256(left ‖ right)`.
@@ -112,12 +161,17 @@ def sha256_pairs_inner(words: jnp.ndarray) -> jnp.ndarray:
     """
     n = words.shape[0]
     state = jnp.broadcast_to(jnp.asarray(H0), (n, 8))
-    state = sha256_blocks(state, words)
+    state = sha256_blocks(state, words, unroll=unroll)
     pad = jnp.broadcast_to(jnp.asarray(_PAD_64), (n, 16))
-    return sha256_blocks(state, pad)
+    return sha256_blocks(state, pad, unroll=unroll)
 
 
-sha256_pairs = jax.jit(sha256_pairs_inner)
+sha256_pairs = jax.jit(sha256_pairs_inner, static_argnames=("unroll",))
+
+# below this many lanes a compression cannot saturate the VPU, so the
+# graph-compact fori form is used there to bound trace/compile time
+# (the wide unrolled levels dominate runtime anyway)
+_UNROLL_MIN_LANES = 4096
 
 
 @jax.jit
@@ -189,8 +243,12 @@ def sha256_many(messages: np.ndarray) -> np.ndarray:
 def _sha256_multiblock(words: jnp.ndarray) -> jnp.ndarray:
     n, n_blocks, _ = words.shape
     state = jnp.broadcast_to(jnp.asarray(H0), (n, 8))
-    for i in range(n_blocks):  # static unroll: block count fixed by shape
-        state = sha256_blocks(state, words[:, i, :])
+    # block count is static (fixed by shape), but the rounds inside each
+    # block only unroll for short messages: a long message would multiply
+    # 64 unrolled rounds by n_blocks and explode trace/compile time
+    unroll = _unroll_for(n) if n_blocks <= 4 else False
+    for i in range(n_blocks):
+        state = sha256_blocks(state, words[:, i, :], unroll=unroll)
     return state
 
 
@@ -224,7 +282,8 @@ def merkle_reduce_words(chunks: jnp.ndarray) -> jnp.ndarray:
         if level.shape[0] % 2 == 1:
             pad = jnp.asarray(_zerohash_words(depth))[None, :]
             level = jnp.concatenate([level, pad], axis=0)
-        level = sha256_pairs_inner(level.reshape(-1, 16))
+        pairs = level.reshape(-1, 16)
+        level = sha256_pairs_inner(pairs, unroll=_unroll_for(pairs.shape[0]))
         depth += 1
     return level[0]
 
@@ -239,8 +298,10 @@ def subtree_roots_words(leaves: jnp.ndarray) -> jnp.ndarray:
     assert P & (P - 1) == 0, "pad element chunk count to a power of two"
     level = leaves
     while level.shape[1] > 1:
+        pairs = level.reshape(-1, 16)
         level = sha256_pairs_inner(
-            level.reshape(-1, 16)).reshape(V, level.shape[1] // 2, 8)
+            pairs, unroll=_unroll_for(pairs.shape[0])
+        ).reshape(V, level.shape[1] // 2, 8)
     return level[:, 0, :]
 
 

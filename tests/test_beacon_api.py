@@ -1,0 +1,131 @@
+"""Beacon-node API facade: the validator-client contract.
+
+Contract: /root/reference specs/validator/beacon_node_oapi.yaml (+ intro
+0_beacon-node-validator-api.md). Drives the full duty cycle a validator
+client performs against a node: discover duties, produce a block, sign,
+publish, produce an attestation, publish — plus every documented error
+path (404 unknown pubkey, 400 invalid, 503 syncing).
+"""
+import pytest
+
+from consensus_specs_tpu.api import ApiError, BeaconNodeAPI, SyncingStatus
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.models import phase0
+from consensus_specs_tpu.testing import factories as f
+from consensus_specs_tpu.testing.keys import privkeys, pubkeys
+
+SPEC = phase0.get_spec("minimal")
+
+
+@pytest.fixture(autouse=True)
+def _bls_off():
+    old = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = old
+
+
+@pytest.fixture()
+def api():
+    state = f.seed_genesis_state(SPEC, SPEC.SLOTS_PER_EPOCH * 8)
+    f.advance_slots(SPEC, state, 3)
+    return BeaconNodeAPI(SPEC, state)
+
+
+def test_node_endpoints(api):
+    assert "consensus-specs-tpu" in api.get_version()
+    assert api.get_genesis_time() == int(api.state.genesis_time)
+    assert api.get_syncing().is_syncing is False
+    fork, chain_id = api.get_fork()
+    assert bytes(fork.current_version) == b"\x00" * 4 and chain_id == 0
+
+
+def test_duties_for_known_pubkeys(api):
+    keys = [pubkeys[i] for i in range(4)]
+    duties = api.get_validator_duties(keys)
+    assert [d.validator_pubkey for d in duties] == [bytes(k) for k in keys]
+    for d in duties:
+        assert d.validator_index in d.committee
+        assert 0 <= d.attestation_shard < SPEC.SHARD_COUNT
+        epoch = SPEC.slot_to_epoch(d.attestation_slot)
+        assert epoch == SPEC.get_current_epoch(api.state)
+
+
+def test_duties_unknown_pubkey_404(api):
+    with pytest.raises(ApiError) as err:
+        api.get_validator_duties([b"\xfe" * 48])
+    assert err.value.status == 404
+
+
+def test_duties_far_epoch_406(api):
+    with pytest.raises(ApiError) as err:
+        api.get_validator_duties([pubkeys[0]], epoch=99)
+    assert err.value.status == 406
+
+
+def test_produce_sign_publish_block(api):
+    slot = int(api.state.slot) + 1
+    proposer = f.proposer_of(SPEC, api.state, slot)
+    block = api.produce_block(slot, randao_reveal=b"\x00" * 96)
+    assert int(block.slot) == slot
+    assert bytes(block.state_root) != b"\x00" * 32
+    f.sign_proposal(SPEC, api.state, block, proposer)
+    pre_slot = int(api.state.slot)
+    api.publish_block(block)
+    assert int(api.state.slot) == slot > pre_slot
+    assert api.published_blocks == [block]
+
+
+def test_publish_invalid_block_400(api):
+    block = api.produce_block(int(api.state.slot) + 1, randao_reveal=b"\x00" * 96)
+    block.state_root = b"\x13" * 32     # corrupt: transition must reject
+    with pytest.raises(ApiError) as err:
+        api.publish_block(block)
+    assert err.value.status == 400
+    assert api.published_blocks == []
+
+
+def test_produce_block_into_past_400(api):
+    with pytest.raises(ApiError) as err:
+        api.produce_block(0, randao_reveal=b"\x00" * 96)
+    assert err.value.status == 400
+
+
+def test_attestation_cycle(api):
+    state = api.state
+    # find a validator whose duty slot is already reachable
+    for i in range(16):
+        duty = api.get_validator_duties([pubkeys[i]])[0]
+        if duty.attestation_slot <= int(state.slot):
+            break
+    else:
+        pytest.skip("no past-duty validator in window")
+    att = api.produce_attestation(
+        pubkeys[i], duty.attestation_slot, duty.attestation_shard)
+    assert bytes(att.signature) == b"\x00" * 96        # unsigned: client signs
+    assert int(att.data.crosslink.shard) == duty.attestation_shard
+    api.publish_attestation(att)
+    assert api.published_attestations == [att]
+
+
+def test_attestation_wrong_shard_400(api):
+    duty = api.get_validator_duties([pubkeys[0]])[0]
+    wrong = (duty.attestation_shard + 1) % SPEC.SHARD_COUNT
+    with pytest.raises(ApiError) as err:
+        api.produce_attestation(pubkeys[0], duty.attestation_slot, wrong)
+    assert err.value.status == 400
+
+
+def test_syncing_node_returns_503():
+    state = f.seed_genesis_state(SPEC, SPEC.SLOTS_PER_EPOCH * 8)
+    api = BeaconNodeAPI(SPEC, state,
+                        syncing=SyncingStatus(is_syncing=True, highest_slot=99))
+    for call in (lambda: api.get_validator_duties([pubkeys[0]]),
+                 lambda: api.produce_block(1, b"\x00" * 96),
+                 lambda: api.publish_attestation(None)):
+        with pytest.raises(ApiError) as err:
+            call()
+        assert err.value.status == 503
+    # /node/* stays available while syncing
+    assert api.get_syncing().is_syncing is True
+    assert api.get_version()

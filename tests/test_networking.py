@@ -1,0 +1,196 @@
+"""Networking model: envelope codec, RPC protocol, gossip router, identity.
+
+Contracts: /root/reference specs/networking/{messaging,rpc-interface,
+libp2p-standardization,node-identification}.md. The reference ships no
+networking code, only these documents — the tests here pin our executable
+model to their MUSTs (ignore malformed, verify ENR signatures, id
+matching, response codes, topic hashing, 512KB cap).
+"""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.networking import (
+    GossipRouter, Hello, MessageEnvelopeError, NodeRecord, RpcError, RpcNode,
+    decode_message, encode_message, loopback_pair, multiaddr, peer_id,
+    shard_attestation_topic, topic_hash)
+from consensus_specs_tpu.networking import messaging, rpc
+from consensus_specs_tpu.testing.keys import privkeys, pubkeys
+from consensus_specs_tpu.utils.hash import sha256
+
+
+# ---------------------------------------------------------------------------
+# Envelope (messaging.md:21-45)
+# ---------------------------------------------------------------------------
+
+def test_envelope_roundtrip():
+    body = b"\x01\x02\x03" * 100
+    wire = encode_message(body)
+    comp, enc, out = decode_message(wire)
+    assert (comp, enc) == (messaging.COMPRESSION_NONE, messaging.ENCODING_SSZ)
+    assert out == body
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda w: w[:5],                                   # short header
+    lambda w: bytes([0x12]) + w[1:],                   # unknown compression
+    lambda w: bytes([0x02]) + w[1:],                   # unknown encoding
+    lambda w: w[:-1],                                  # truncated body
+    lambda w: w + b"\x00",                             # trailing junk
+])
+def test_malformed_envelopes_are_ignorable(mutate):
+    wire = encode_message(b"payload")
+    with pytest.raises(MessageEnvelopeError):
+        decode_message(mutate(wire))
+
+
+def test_tcp_prefix():
+    framed = messaging.frame_tcp(encode_message(b"x"))
+    assert framed.startswith(b"ETH") and framed[:3] == bytes.fromhex("455448")
+    assert messaging.unframe_tcp(framed) == encode_message(b"x")
+    with pytest.raises(MessageEnvelopeError):
+        messaging.unframe_tcp(b"BTC" + b"rest")
+
+
+# ---------------------------------------------------------------------------
+# RPC (rpc-interface.md)
+# ---------------------------------------------------------------------------
+
+def _hello(net=1, slot=64):
+    return Hello(network_id=net, chain_id=1,
+                 latest_finalized_root=b"\x0a" * 32,
+                 latest_finalized_epoch=2,
+                 best_root=b"\x0b" * 32, best_slot=slot)
+
+
+def test_hello_exchange_and_id_matching():
+    a, b = loopback_pair()
+    b.register(rpc.HELLO, lambda h: _hello(net=1, slot=128))
+    first = a.call(rpc.HELLO, _hello())
+    second = a.call(rpc.HELLO, _hello())
+    assert int(first.best_slot) == 128 and int(second.best_slot) == 128
+    assert a._next_id == 2   # monotonic per-connection ids
+
+
+def test_goodbye_records_reason_and_returns_empty():
+    a, b = loopback_pair()
+    assert a.call(rpc.GOODBYE, rpc.Goodbye(reason=2)) is None
+    assert b.said_goodbye == 2
+
+
+def test_method_not_found_code():
+    a, _ = loopback_pair()
+    with pytest.raises(RpcError) as err:
+        a.call(rpc.BEACON_BLOCK_ROOTS,
+               rpc.BlockRootsRequest(start_slot=0, count=10))
+    assert err.value.code == rpc.METHOD_NOT_FOUND
+
+
+def test_block_roots_request_response():
+    a, b = loopback_pair()
+
+    def serve(req):
+        assert int(req.count) <= rpc.MAX_BLOCK_ROOTS_COUNT
+        return rpc.BlockRootsResponse(roots=[
+            rpc.BlockRootSlot(block_root=bytes([s]) * 32, slot=s)
+            for s in range(int(req.start_slot), int(req.start_slot) + 3)
+        ])
+
+    b.register(rpc.BEACON_BLOCK_ROOTS, serve)
+    resp = a.call(rpc.BEACON_BLOCK_ROOTS,
+                  rpc.BlockRootsRequest(start_slot=5, count=3))
+    slots = [int(r.slot) for r in resp.roots]
+    assert slots == sorted(slots) == [5, 6, 7]
+
+
+def test_server_error_maps_to_code():
+    a, b = loopback_pair()
+    b.register(rpc.GET_STATUS, lambda s: 1 / 0)
+    with pytest.raises(RpcError) as err:
+        a.call(rpc.GET_STATUS, rpc.Status(sha=b"\x00" * 32,
+                                          user_agent=b"t", timestamp=0))
+    assert err.value.code == rpc.SERVER_ERROR
+
+
+def test_parse_error_on_garbage_wire():
+    node = RpcNode()
+    resp_wire = node.handle_wire(b"\xff" * 40)
+    _, _, payload = decode_message(resp_wire)
+    from consensus_specs_tpu.utils.ssz.impl import deserialize
+    resp = deserialize(payload, rpc.Response)
+    assert int(resp.response_code) == rpc.PARSE_ERROR
+
+
+def test_handshake_disconnect_policy():
+    mine, theirs = _hello(net=1), _hello(net=2)
+    assert rpc.should_disconnect(mine, theirs, lambda e: None)
+    same_net = _hello(net=1)
+    # peer's finalized root not on our chain at that epoch -> disconnect
+    assert rpc.should_disconnect(mine, same_net, lambda e: b"\xff" * 32)
+    # matching root (or unknown epoch) -> stay
+    assert not rpc.should_disconnect(mine, same_net, lambda e: b"\x0a" * 32)
+    assert not rpc.should_disconnect(mine, same_net, lambda e: None)
+
+
+# ---------------------------------------------------------------------------
+# Gossip (libp2p-standardization.md:72-158)
+# ---------------------------------------------------------------------------
+
+def test_topic_hash_and_shard_subnets():
+    assert topic_hash("beacon_block") == sha256(b"beacon_block")
+    assert shard_attestation_topic(shard=1029, shard_subnet_count=16) == \
+        "shard5_attestation"
+
+
+def test_gossip_delivery_and_dedup():
+    router = GossipRouter()
+    seen = {"a": [], "b": [], "c": []}
+    for node in seen:
+        router.subscribe(node, "beacon_block",
+                         lambda t, p, node=node: seen[node].append(p))
+    reached = router.publish("a", "beacon_block", b"block-bytes")
+    assert reached == 2                       # everyone but the publisher
+    assert seen["a"] == [] and seen["b"] == [b"block-bytes"]
+    # a forwarding node re-publishing is a no-op (seen-cache)
+    assert router.publish("b", "beacon_block", b"block-bytes") == 0
+
+
+def test_gossip_message_size_cap():
+    router = GossipRouter()
+    router.subscribe("b", "beacon_block", lambda t, p: None)
+    assert router.publish("a", "beacon_block",
+                          b"\x00" * (512 * 1024 + 1)) == 0
+    assert router.dropped_oversize == 1
+
+
+# ---------------------------------------------------------------------------
+# Identity (node-identification.md:11-27)
+# ---------------------------------------------------------------------------
+
+def test_node_record_sign_verify_and_multiaddr():
+    old = bls.bls_active
+    bls.bls_active = True
+    try:
+        record = NodeRecord(ip="10.0.0.1", pubkey=pubkeys[0]).sign(privkeys[0])
+        assert record.tcp_port == 9000
+        assert record.verify()
+        # MUST disconnect on bad signatures: any content change invalidates
+        record.seq += 1
+        assert not record.verify()
+    finally:
+        bls.bls_active = old
+    pid = peer_id(pubkeys[0])
+    assert pid[:2] == bytes([0x12, 0x20]) and len(pid) == 34
+    addr = multiaddr(NodeRecord(ip="10.0.0.1", pubkey=pubkeys[0]))
+    assert addr.startswith("/ip4/10.0.0.1/tcp/9000/p2p/1220")
+
+
+def test_untyped_method_registration_round_trips():
+    """Reserved/custom method ids (e.g. BEACON_CHAIN_STATE=13) work once a
+    node registers types — or raw bytes handlers on both ends."""
+    a, b = loopback_pair()
+    b.register(rpc.BEACON_CHAIN_STATE, lambda raw: raw[::-1])  # raw-bytes echo
+    with pytest.raises(RpcError) as err:
+        a.call(rpc.BEACON_CHAIN_STATE, b"\x01\x02")  # a has no types for 13
+    assert err.value.code == rpc.METHOD_NOT_FOUND
+    a.register(rpc.BEACON_CHAIN_STATE, lambda raw: raw)  # untyped on a too
+    assert a.call(rpc.BEACON_CHAIN_STATE, b"\x01\x02") == b"\x02\x01"

@@ -2,6 +2,7 @@
 import hashlib
 
 import numpy as np
+import pytest
 
 from consensus_specs_tpu.ops import sha256 as k
 from consensus_specs_tpu.utils.merkle import merkleize_chunks
@@ -52,3 +53,18 @@ def test_words_roundtrip():
     rng = np.random.default_rng(4)
     data = rng.integers(0, 256, (7, 64), dtype=np.uint8)
     assert np.array_equal(k.words_to_bytes(k.bytes_to_words(data)), data)
+
+
+def test_unrolled_equals_fori_rounds():
+    """The two round structures must agree bit-for-bit. XLA:CPU cannot
+    compile the unrolled form (simplifier loop — see ops/sha256._unroll_for),
+    so this runs only against a real accelerator (CSTPU_TEST_TPU=1)."""
+    import jax
+    if jax.default_backend() == "cpu":
+        pytest.skip("unrolled form is TPU-only (XLA:CPU simplifier loop)")
+    import jax.numpy as jnp
+    rng = np.random.default_rng(5)
+    words = jnp.asarray(rng.integers(0, 2 ** 32, (8192, 16), dtype=np.uint32))
+    a = np.asarray(k.sha256_pairs(words, unroll=True))
+    b = np.asarray(k.sha256_pairs(words, unroll=False))
+    assert (a == b).all()
