@@ -111,6 +111,8 @@ def bench_state_root_device() -> float:
     t0 = time.perf_counter()
     iters = 3
     for _ in range(iters):
+        # the callee materializes the 32-byte roots on the host
+        # (np.asarray + tobytes), which IS the completion fence here
         bulk.registry_and_balances_roots_device(*dev)
     return (time.perf_counter() - t0) / iters
 

@@ -22,6 +22,12 @@ from copy import deepcopy
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
+# exception classes that map to HTTP 400 at the API boundary: spec asserts
+# (AssertionError/IndexError) plus the malformed-container classes a
+# wrong-typed field raises inside the transition or SSZ machinery
+_INVALID = (AssertionError, IndexError, TypeError, ValueError,
+            AttributeError, KeyError)
+
 VERSION = "consensus-specs-tpu/0.3"
 
 
@@ -96,11 +102,7 @@ class BeaconNodeAPI:
             if assignment is None:
                 raise ApiError(406, "no assignment in requested epoch")
             committee, shard, slot = assignment
-            proposal_slot = None
-            if epoch == spec.get_current_epoch(state) and \
-                    state.slot >= spec.get_epoch_start_slot(epoch):
-                if spec.is_proposer(state, index):
-                    proposal_slot = int(state.slot)
+            proposal_slot = self._find_proposal_slot(index, epoch)
             duties.append(ValidatorDuty(
                 validator_pubkey=bytes(pubkey),
                 attestation_slot=int(slot),
@@ -110,6 +112,35 @@ class BeaconNodeAPI:
                 block_proposal_slot=proposal_slot,
             ))
         return duties
+
+    def _find_proposal_slot(self, index: int, epoch: int) -> Optional[int]:
+        """First slot in `epoch` (not before the head) where `index`
+        proposes. The proposer for a future slot depends on the state AT
+        that slot, so one scratch copy advances through the epoch's
+        remaining slots and the resulting slot->proposer map is cached per
+        head slot — proposal lookahead is only reliable within the current
+        epoch (0_beacon-chain-validator.md:160-166)."""
+        spec, state = self.spec, self.state
+        if epoch != spec.get_current_epoch(state):
+            return None
+        cache_key = (epoch, int(state.slot))
+        if getattr(self, "_proposer_map_key", None) != cache_key:
+            last_slot = (spec.get_epoch_start_slot(epoch)
+                         + spec.SLOTS_PER_EPOCH - 1)
+            mapping = {}
+            scratch = None
+            for slot in range(max(int(state.slot), 1), last_slot + 1):
+                if slot == int(state.slot):
+                    probe = state
+                else:
+                    if scratch is None:
+                        scratch = deepcopy(state)
+                    spec.process_slots(scratch, slot)
+                    probe = scratch
+                mapping.setdefault(spec.get_beacon_proposer_index(probe), slot)
+            self._proposer_map = mapping
+            self._proposer_map_key = cache_key
+        return self._proposer_map.get(index)
 
     # -- /validator/block ---------------------------------------------------
 
@@ -133,7 +164,7 @@ class BeaconNodeAPI:
         try:
             spec.state_transition(scratch, block)
             block.state_root = spec.hash_tree_root(scratch)
-        except (AssertionError, IndexError):
+        except _INVALID:
             raise ApiError(400, "slot not reachable from head state")
         finally:
             bls.bls_active = old
@@ -149,13 +180,12 @@ class BeaconNodeAPI:
             # a node accepting an external block verifies its claimed root
             # (0_beacon-chain.md:1214-1216)
             spec.state_transition(scratch, block, validate_state_root=True)
-        except (AssertionError, IndexError):
+        except _INVALID:
             raise ApiError(400, "block failed state transition")
         self.state = scratch
-        self._pubkey_index = {
-            bytes(v.pubkey): i
-            for i, v in enumerate(scratch.validator_registry)
-        }
+        # registry is append-only: extend the index for new deposits only
+        for i in range(len(self._pubkey_index), len(scratch.validator_registry)):
+            self._pubkey_index[bytes(scratch.validator_registry[i].pubkey)] = i
         self.published_blocks.append(block)
 
     # -- /validator/attestation --------------------------------------------
@@ -178,7 +208,8 @@ class BeaconNodeAPI:
         from ..models.phase0.validator import build_attestation_duty
         head_root = spec.signing_root(state.latest_block_header)
         att = build_attestation_duty(
-            spec, state, head_root, committee, int(shard), index, privkey=None)
+            spec, state, head_root, committee, int(shard), index,
+            privkey=None, custody_bit=bool(poc_bit))
         return att
 
     def publish_attestation(self, attestation) -> None:
@@ -190,7 +221,7 @@ class BeaconNodeAPI:
         try:
             data_slot = spec.get_attestation_data_slot(state, attestation.data)
             assert data_slot <= state.slot
-        except (AssertionError, IndexError):
+        except _INVALID:
             raise ApiError(400, "malformed attestation")
         self.published_attestations.append(attestation)
 

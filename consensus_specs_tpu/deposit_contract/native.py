@@ -1,0 +1,114 @@
+"""ctypes bridge to the native deposit-tree accumulator.
+
+Loads (building on first use) native/deposit_tree.cpp — the C++
+counterpart of the reference's EVM deposit contract
+(/root/reference deposit_contract/contracts/validator_registration.v.py:
+69-140). The Python model (contract.py) remains the behavioral oracle;
+`NativeDepositTree` must agree with it byte-for-byte
+(tests/test_deposit_contract.py::test_native_*), giving the same
+python <-> native differential the reference runs python <-> EVM
+(deposit_contract/tests/contracts/test_deposit.py).
+
+Build is lazy via g++ (`-O3 -shared -fPIC`) into the repo .cache dir; on a
+machine without a toolchain `available()` is False and callers skip.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "native", "deposit_tree.cpp")
+_LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "..", ".cache", "native")
+_LIB = os.path.join(_LIB_DIR, "libdeposit_tree.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    try:
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            os.makedirs(_LIB_DIR, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB],
+                check=True, capture_output=True, timeout=120)
+        lib = ctypes.CDLL(_LIB)
+    except Exception:
+        _build_failed = True
+        return None
+    lib.dt_new.restype = ctypes.c_void_p
+    lib.dt_free.argtypes = [ctypes.c_void_p]
+    lib.dt_count.restype = ctypes.c_uint64
+    lib.dt_count.argtypes = [ctypes.c_void_p]
+    lib.dt_deposit.restype = ctypes.c_int
+    lib.dt_deposit.argtypes = [ctypes.c_void_p] + [ctypes.c_char_p] * 3 + [ctypes.c_uint64]
+    lib.dt_deposit_batch.restype = ctypes.c_int
+    lib.dt_deposit_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.dt_root.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeDepositTree:
+    """Same surface as contract.DepositContract's accumulator core."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native deposit tree unavailable (no g++?)")
+        self._lib = lib
+        self._h = lib.dt_new()
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.dt_free(self._h)
+            self._h = None
+
+    @property
+    def deposit_count(self) -> int:
+        return int(self._lib.dt_count(self._h))
+
+    def deposit(self, pubkey: bytes, withdrawal_credentials: bytes,
+                signature: bytes, value_gwei: int) -> None:
+        assert len(pubkey) == 48 and len(withdrawal_credentials) == 32 \
+            and len(signature) == 96
+        rc = self._lib.dt_deposit(self._h, pubkey, withdrawal_credentials,
+                                  signature, value_gwei)
+        assert rc == 0, f"native deposit rejected (rc={rc})"
+
+    def deposit_batch(self, pubkeys: np.ndarray, wcs: np.ndarray,
+                      sigs: np.ndarray, values: np.ndarray) -> None:
+        """Column batches: [n,48]/[n,32]/[n,96] uint8 + [n] uint64."""
+        n = pubkeys.shape[0]
+        values = np.ascontiguousarray(values, dtype=np.uint64)
+        rc = self._lib.dt_deposit_batch(
+            self._h, n,
+            np.ascontiguousarray(pubkeys, np.uint8).tobytes(),
+            np.ascontiguousarray(wcs, np.uint8).tobytes(),
+            np.ascontiguousarray(sigs, np.uint8).tobytes(),
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        assert rc == 0, f"native batch deposit rejected (rc={rc})"
+
+    def get_deposit_root(self) -> bytes:
+        out = ctypes.create_string_buffer(32)
+        self._lib.dt_root(self._h, out)
+        return out.raw

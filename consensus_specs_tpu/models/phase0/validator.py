@@ -116,11 +116,14 @@ def build_proposal(spec, state, slot: int, parent_root: bytes, privkey: int,
 
 def build_attestation_duty(spec, head_state, head_block_root: bytes,
                            committee: List[int], shard: int,
-                           validator_index: int, privkey: Optional[int]):
+                           validator_index: int, privkey: Optional[int],
+                           custody_bit: bool = False):
     """The validator's single-bit attestation for its assigned (committee,
     shard) at the head state's slot (:278-361). privkey None returns the
     attestation unsigned (the beacon-node API's produce path: the client
-    holds the key and signs, beacon_node_oapi.yaml /validator/attestation)."""
+    holds the key and signs, beacon_node_oapi.yaml /validator/attestation).
+    custody_bit sets the validator's proof-of-custody bit (:331-340 —
+    always False in phase 0; phase 1 clients pass their computed bit)."""
     epoch_start_slot = spec.get_epoch_start_slot(spec.get_current_epoch(head_state))
     if epoch_start_slot == head_state.slot:
         target_root = head_block_root
@@ -148,11 +151,15 @@ def build_attestation_duty(spec, head_state, head_block_root: bytes,
     bits = bytearray(width)
     position = committee.index(validator_index)
     bits[position // 8] |= 1 << (position % 8)
+    custody = bytearray(width)
+    if custody_bit:
+        custody[position // 8] |= 1 << (position % 8)
 
     if privkey is None:
         signature = b"\x00" * 96
     else:
-        wrapped = spec.AttestationDataAndCustodyBit(data=data, custody_bit=False)
+        wrapped = spec.AttestationDataAndCustodyBit(data=data,
+                                                    custody_bit=custody_bit)
         signature = spec.bls.bls_sign(
             message_hash=spec.hash_tree_root(wrapped),
             privkey=privkey,
@@ -162,7 +169,7 @@ def build_attestation_duty(spec, head_state, head_block_root: bytes,
     return spec.Attestation(
         aggregation_bitfield=bytes(bits),
         data=data,
-        custody_bitfield=b"\x00" * width,
+        custody_bitfield=bytes(custody),
         signature=signature,
     )
 

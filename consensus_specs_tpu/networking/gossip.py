@@ -64,6 +64,7 @@ class GossipRouter:
         self._seen: Set[bytes] = set()
         self.delivered = 0   # observability: total handler invocations
         self.dropped_oversize = 0
+        self.handler_failures = 0
 
     def subscribe(self, node_id: str, topic: str,
                   handler: Callable[[str, bytes], None]) -> None:
@@ -79,12 +80,20 @@ class GossipRouter:
         digest = sha256(topic_hash(topic) + payload)
         if digest in self._seen:
             return 0
-        self._seen.add(digest)
         reached = 0
         for sub_id, handler in self._subs.get(topic_hash(topic), []):
             if sub_id == node_id:
                 continue
-            handler(topic, payload)
-            reached += 1
+            try:
+                handler(topic, payload)
+                reached += 1
+            except Exception:
+                # a peer's handler failing is that peer's problem: delivery
+                # to the others proceeds and the failure is observable
+                self.handler_failures += 1
+        # mark seen only after the delivery sweep, so a message whose sweep
+        # raised out of the router (impossible above, but future-proof)
+        # would not be permanently blacklisted half-delivered
+        self._seen.add(digest)
         self.delivered += reached
         return reached

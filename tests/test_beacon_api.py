@@ -129,3 +129,44 @@ def test_syncing_node_returns_503():
     # /node/* stays available while syncing
     assert api.get_syncing().is_syncing is True
     assert api.get_version()
+
+
+def test_duty_proposal_slot_covers_future_slots(api):
+    """Every slot in the rest of the epoch must be claimable by exactly one
+    duty: scanning all validators' duties, the proposal slots seen must
+    cover the state's remaining epoch slots."""
+    duties = api.get_validator_duties(
+        [pubkeys[i] for i in range(len(api.state.validator_registry))])
+    slots = sorted(d.block_proposal_slot for d in duties
+                   if d.block_proposal_slot is not None)
+    last = SPEC.get_epoch_start_slot(SPEC.get_current_epoch(api.state)) \
+        + SPEC.SLOTS_PER_EPOCH - 1
+    assert slots, "someone must propose"
+    assert all(int(api.state.slot) <= s <= last for s in slots)
+    assert len(set(slots)) == len(slots)   # one proposer per slot
+    assert int(api.state.slot) in slots    # head slot's proposer visible
+
+
+def test_publish_malformed_block_maps_to_400(api):
+    block = api.produce_block(int(api.state.slot) + 1, b"\x00" * 96)
+    block.slot = None   # wrong-typed field: must be 400, not TypeError
+    with pytest.raises(ApiError) as err:
+        api.publish_block(block)
+    assert err.value.status == 400
+
+
+def test_attestation_poc_bit_sets_custody_bit(api):
+    state = api.state
+    for i in range(16):
+        duty = api.get_validator_duties([pubkeys[i]])[0]
+        if duty.attestation_slot <= int(state.slot):
+            break
+    else:
+        pytest.skip("no past-duty validator in window")
+    att = api.produce_attestation(
+        pubkeys[i], duty.attestation_slot, duty.attestation_shard, poc_bit=1)
+    position = duty.committee.index(duty.validator_index)
+    assert att.custody_bitfield[position // 8] & (1 << (position % 8))
+    att0 = api.produce_attestation(
+        pubkeys[i], duty.attestation_slot, duty.attestation_shard, poc_bit=0)
+    assert att0.custody_bitfield == bytes(len(att0.custody_bitfield))

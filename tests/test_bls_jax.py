@@ -265,3 +265,25 @@ def test_verify_multiple_batch_ragged_and_infinity(backends):
     assert py.verify_multiple(*empty)                # oracle: empty product
     got = jx.verify_multiple_batch([one, empty, two])
     assert got == [True, True, True]
+
+
+def test_aggregate_pubkeys_rejects_malformed_like_oracle(backends):
+    """The fused device decompress+aggregate must reject exactly what the
+    bignum oracle rejects (bad flags / off-curve), and treat the infinity
+    pubkey as the identity, byte-for-byte."""
+    py, jx = backends
+    good = [gt.privtopub(k) for k in PRIVKEYS[:3]]
+    inf = gt.compress_g1(None)
+    assert jx.aggregate_pubkeys(good + [inf]) == \
+        py.aggregate_pubkeys(good + [inf]) == jx.aggregate_pubkeys(good)
+    # an x whose x^3+4 is a quadratic non-residue: genuinely off-curve
+    x_off = next(x for x in range(2, 50)
+                 if pow(x ** 3 + 4, (gt.q - 1) // 2, gt.q) != 1)
+    off_curve = bytearray(x_off.to_bytes(48, "big"))
+    off_curve[0] |= 0x80
+    for bad in (bytes(off_curve),                       # not on curve
+                bytes([good[0][0] & 0x7F]) + good[0][1:],   # c_flag unset
+                bytes([0xE0]) + b"\x00" * 47):          # infinity with a_flag
+        for backend in (py, jx):
+            with pytest.raises(AssertionError):
+                backend.aggregate_pubkeys(good + [bad])

@@ -1,0 +1,89 @@
+"""Device G1 decompression vs the bignum oracle.
+
+Contract: the compressed-point grammar of bls_signature.md:36-64 as
+implemented by crypto/bls12_381.decompress_g1 (:368-386) — same accepted
+set, same rejected set, same (x, y) for every valid encoding.
+"""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.crypto import bls12_381 as gt
+from consensus_specs_tpu.ops import decompress as D
+from consensus_specs_tpu.ops import fq as F
+
+
+def _oracle(data: bytes):
+    try:
+        return gt.decompress_g1(data)   # None = infinity
+    except AssertionError:
+        return "invalid"
+
+
+def _batch(encodings):
+    data = np.stack([np.frombuffer(e, np.uint8) for e in encodings])
+    x, y, valid, inf = D.g1_decompress_batch(data)
+    out = []
+    for i in range(len(encodings)):
+        if not valid[i]:
+            out.append("invalid")
+        elif inf[i]:
+            out.append(None)
+        else:
+            out.append((F.from_mont(np.asarray(x)[i]),
+                        F.from_mont(np.asarray(y)[i])))
+    return out
+
+
+def test_valid_points_match_oracle():
+    encodings = [gt.compress_g1(gt.ec_mul(gt.G1_GEN, k)) for k in range(1, 9)]
+    got = _batch(encodings)
+    want = [_oracle(e) for e in encodings]
+    assert got == want
+    assert all(isinstance(p, tuple) for p in got)
+
+
+def test_infinity_encoding():
+    inf = gt.compress_g1(None)
+    assert _batch([inf]) == [None] == [_oracle(inf)]
+
+
+def test_malformed_encodings_rejected():
+    base = bytearray(gt.compress_g1(gt.ec_mul(gt.G1_GEN, 3)))
+    cases = []
+    no_c = bytes([base[0] & 0x7F]) + bytes(base[1:])          # c_flag unset
+    cases.append(no_c)
+    bad_inf = bytes([0xC0 | 0x20]) + b"\x00" * 47             # b with a set
+    cases.append(bad_inf)
+    bad_inf2 = bytes([0xC0]) + b"\x00" * 46 + b"\x01"         # b with x != 0
+    cases.append(bad_inf2)
+    over_q = bytearray((F.Q + 1).to_bytes(48, "big"))
+    over_q[0] |= 0x80                                          # x >= q
+    cases.append(bytes(over_q))
+    off_curve = bytearray(base)
+    off_curve[-1] ^= 0x01                                      # x not on curve (w.h.p.)
+    cases.append(bytes(off_curve))
+    got = _batch(cases)
+    want = [_oracle(bytes(c)) for c in cases]
+    assert got == want
+    assert all(v == "invalid" for v in want[:4])
+
+
+def test_both_sign_flags_roundtrip():
+    pt = gt.ec_mul(gt.G1_GEN, 7)
+    x, y = pt
+    enc_pos = gt.compress_g1((x, y))
+    enc_neg = gt.compress_g1((x, gt.q - y))
+    got = _batch([enc_pos, enc_neg])
+    assert got[0] == (x, y)
+    assert got[1] == (x, gt.q - y)
+    assert got[0] != got[1]
+
+
+def test_large_batch_matches():
+    encodings = [gt.compress_g1(gt.ec_mul(gt.G1_GEN, k)) for k in range(1, 33)]
+    rng = np.random.default_rng(0)
+    corrupt = rng.integers(0, 256, (4, 48), dtype=np.uint8).tobytes()
+    encodings += [corrupt[i * 48:(i + 1) * 48] for i in range(4)]
+    got = _batch(encodings)
+    want = [_oracle(bytes(e)) for e in encodings]
+    assert got == want

@@ -141,3 +141,55 @@ def test_deposit_events_logged():
     assert event.pubkey == bytes([5]) * 48
     assert event.merkle_tree_index == (0).to_bytes(8, "little")
     assert event.amount == FULL_DEPOSIT_GWEI.to_bytes(8, "little")
+
+
+# ---------------------------------------------------------------------------
+# Native (C++) accumulator: the python <-> native differential, mirroring
+# the reference's python <-> EVM cross-check
+# (/root/reference deposit_contract/tests/contracts/test_deposit.py)
+# ---------------------------------------------------------------------------
+
+native = pytest.importorskip("consensus_specs_tpu.deposit_contract.native")
+
+
+@pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+def test_native_tree_matches_python_model():
+    from random import Random
+    rng = Random(77)
+    py = DepositContract()
+    cc = native.NativeDepositTree()
+    assert cc.get_deposit_root() == py.get_deposit_root()
+    for i in range(33):   # crosses several subtree-completion boundaries
+        pk = bytes(rng.randrange(256) for _ in range(48))
+        wc = bytes(rng.randrange(256) for _ in range(32))
+        sig = bytes(rng.randrange(256) for _ in range(96))
+        amount = rng.choice([1_000_000_000, 32_000_000_000, 5_555_555_555])
+        py.deposit(pk, wc, sig, amount)
+        cc.deposit(pk, wc, sig, amount)
+        assert cc.deposit_count == py.deposit_count == i + 1
+        assert cc.get_deposit_root() == py.get_deposit_root(), i
+
+
+@pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+def test_native_batch_matches_sequential():
+    import numpy as np
+    rng = np.random.default_rng(9)
+    n = 20
+    pks = rng.integers(0, 256, (n, 48), dtype=np.uint8)
+    wcs = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+    sigs = rng.integers(0, 256, (n, 96), dtype=np.uint8)
+    vals = np.full(n, 32_000_000_000, np.uint64)
+    a, b = native.NativeDepositTree(), native.NativeDepositTree()
+    a.deposit_batch(pks, wcs, sigs, vals)
+    for i in range(n):
+        b.deposit(pks[i].tobytes(), wcs[i].tobytes(), sigs[i].tobytes(),
+                  int(vals[i]))
+    assert a.get_deposit_root() == b.get_deposit_root()
+    assert a.deposit_count == n
+
+
+@pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+def test_native_rejects_below_minimum():
+    cc = native.NativeDepositTree()
+    with pytest.raises(AssertionError):
+        cc.deposit(b"\x01" * 48, b"\x02" * 32, b"\x03" * 96, 999)

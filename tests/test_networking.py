@@ -194,3 +194,13 @@ def test_untyped_method_registration_round_trips():
     assert err.value.code == rpc.METHOD_NOT_FOUND
     a.register(rpc.BEACON_CHAIN_STATE, lambda raw: raw)  # untyped on a too
     assert a.call(rpc.BEACON_CHAIN_STATE, b"\x01\x02") == b"\x02\x01"
+
+
+def test_gossip_handler_failure_isolated():
+    router = GossipRouter()
+    got = []
+    router.subscribe("bad", "beacon_block",
+                     lambda t, p: (_ for _ in ()).throw(RuntimeError("boom")))
+    router.subscribe("good", "beacon_block", lambda t, p: got.append(p))
+    assert router.publish("src", "beacon_block", b"payload") == 1
+    assert got == [b"payload"] and router.handler_failures == 1
