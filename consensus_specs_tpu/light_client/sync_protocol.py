@@ -31,8 +31,8 @@ Design notes (adaptation, not translation):
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Dict, List, Sequence
+from dataclasses import dataclass
+from typing import Dict, List
 
 
 # ---------------------------------------------------------------------------

@@ -12,7 +12,7 @@ from consensus_specs_tpu.api import ApiError, BeaconNodeAPI, SyncingStatus
 from consensus_specs_tpu.crypto import bls
 from consensus_specs_tpu.models import phase0
 from consensus_specs_tpu.testing import factories as f
-from consensus_specs_tpu.testing.keys import privkeys, pubkeys
+from consensus_specs_tpu.testing.keys import pubkeys
 
 SPEC = phase0.get_spec("minimal")
 

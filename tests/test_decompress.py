@@ -5,7 +5,6 @@ implemented by crypto/bls12_381.decompress_g1 (:368-386) — same accepted
 set, same rejected set, same (x, y) for every valid encoding.
 """
 import numpy as np
-import pytest
 
 from consensus_specs_tpu.crypto import bls12_381 as gt
 from consensus_specs_tpu.ops import decompress as D
