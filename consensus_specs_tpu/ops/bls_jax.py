@@ -375,15 +375,25 @@ def _g1_decompress_aggregate_jit(x_raw, a_flag, is_inf):
 
 
 @jax.jit
-def _g2_aggregate(pts):
-    """[N, 3, 2, L] Jacobian (infinity-padded, N a power of two) -> affine."""
-    cur = (pts[:, 0], pts[:, 1], pts[:, 2])
+def _g2_decompress_aggregate_jit(x_raw, a_flag, is_inf):
+    """Fused G2 decompress (Fq2 sqrt ladder) + addition tree; mirrors
+    _g1_decompress_aggregate_jit's contract with [N, 2, L] coordinates."""
+    x, y, valid = decomp._g2_decompress_traced(x_raw, a_flag)
+    all_valid = jnp.all(valid | is_inf)
+    one = jnp.asarray(np.asarray(F.to_mont(1), np.int64))
+    zero_fq2 = jnp.zeros_like(x)
+    one_fq2 = jnp.zeros_like(x).at[..., 0, :].set(one)
+    jac_x = T.fq2_select(is_inf, zero_fq2, x)
+    jac_y = T.fq2_select(is_inf, one_fq2, y)
+    jac_z = T.fq2_select(is_inf, zero_fq2, one_fq2)
+    cur = (jac_x, jac_y, jac_z)
     while cur[0].shape[0] > 1:
         a = tuple(c[0::2] for c in cur)
         b = tuple(c[1::2] for c in cur)
         cur = jac_add(G2_OPS, a, b)
     single = tuple(c[0] for c in cur)
-    return jac_to_affine(G2_OPS, single)
+    x_aff, y_aff, inf = jac_to_affine(G2_OPS, single)
+    return x_aff, y_aff, inf, all_valid
 
 
 @jax.jit
@@ -422,6 +432,35 @@ def _next_pow2(n: int) -> int:
     while p < n:
         p *= 2
     return p
+
+
+def _decompress_and_aggregate(encodings, *, enc_len, label, parse,
+                              coord_shape, agg_jit, compress, infinity):
+    """Shared stage/pad/assert scaffold for the fused decompress+aggregate
+    paths: one body keeps the G1 and G2 accept/reject behavior locked
+    together (the per-curve pieces — parse grammar, coordinate shape, the
+    jitted program, compression — are parameters)."""
+    if not encodings:
+        return infinity()
+    assert all(len(bytes(e)) == enc_len for e in encodings), \
+        f"G{'1' if enc_len == 48 else '2'} {label} must be {enc_len} bytes"
+    data = np.stack([np.frombuffer(bytes(e), np.uint8) for e in encodings])
+    x_raw, a_flag, is_inf, wellformed = parse(data)
+    assert bool(wellformed.all()), f"malformed {label} encoding"
+    n = data.shape[0]
+    pad = _next_pow2(n)
+    if pad != n:
+        x_raw = np.concatenate(
+            [x_raw, np.zeros((pad - n,) + coord_shape, np.int64)])
+        a_flag = np.concatenate([a_flag, np.zeros(pad - n, bool)])
+        is_inf = np.concatenate([is_inf, np.ones(pad - n, bool)])
+    x, y, inf, all_valid = agg_jit(
+        jnp.asarray(x_raw), jnp.asarray(a_flag), jnp.asarray(is_inf))
+    assert bool(np.asarray(all_valid)), \
+        f"{label} not on curve / out of range"
+    if bool(np.asarray(inf)):
+        return infinity()
+    return compress(x, y)
 
 
 # ---------------------------------------------------------------------------
@@ -524,43 +563,25 @@ class JaxBackend:
         (ops/decompress.py); the host only parses bytes with vectorized
         numpy and compresses the single affine result. Byte-identical to
         the bignum oracle, including rejection of malformed encodings."""
-        if not pubkeys:
-            return gt.compress_g1(None)
-        assert all(len(bytes(p)) == 48 for p in pubkeys), \
-            "G1 pubkey must be 48 bytes"   # before np.stack: ragged input raises here
-        data = np.stack([np.frombuffer(bytes(p), np.uint8) for p in pubkeys])
-        limbs, a_flag, is_inf, wellformed = decomp.parse_g1_bytes(data)
-        assert bool(wellformed.all()), "malformed pubkey encoding"
-        n = data.shape[0]
-        pad = _next_pow2(n)
-        if pad != n:
-            limbs = np.concatenate([limbs, np.zeros((pad - n, F.L), np.int64)])
-            a_flag = np.concatenate([a_flag, np.zeros(pad - n, bool)])
-            is_inf = np.concatenate([is_inf, np.ones(pad - n, bool)])
-        x, y, inf, all_valid = _g1_decompress_aggregate_jit(
-            jnp.asarray(limbs), jnp.asarray(a_flag), jnp.asarray(is_inf))
-        assert bool(np.asarray(all_valid)), "pubkey not on curve / out of range"
-        if bool(np.asarray(inf)):
-            return gt.compress_g1(None)
-        return gt.compress_g1((F.from_mont(np.asarray(x)), F.from_mont(np.asarray(y))))
+        return _decompress_and_aggregate(
+            pubkeys, enc_len=48, label="pubkey",
+            parse=decomp.parse_g1_bytes, coord_shape=(F.L,),
+            agg_jit=_g1_decompress_aggregate_jit,
+            compress=lambda x, y: gt.compress_g1(
+                (F.from_mont(np.asarray(x)), F.from_mont(np.asarray(y)))),
+            infinity=lambda: gt.compress_g1(None))
 
     def aggregate_signatures(self, signatures: Sequence[bytes]) -> bytes:
-        pts = [gt.decompress_g2(s) for s in signatures]
-        pts = [p for p in pts if p is not None]
-        if not pts:
-            return gt.compress_g2(None)
-        n = _next_pow2(len(pts))
-        arr = np.zeros((n, 3, 2, F.L), dtype=np.int64)
-        arr[:, 1, 0] = F.to_mont(1)
-        for i, (x, y) in enumerate(pts):
-            arr[i, 0] = T.fq2_to_limbs(x)
-            arr[i, 1] = T.fq2_to_limbs(y)
-            arr[i, 2, 0] = F.to_mont(1)
-        x, y, inf = _g2_aggregate(jnp.asarray(arr))
-        if bool(np.asarray(inf)):
-            return gt.compress_g2(None)
-        return gt.compress_g2((T.fq2_from_limbs(np.asarray(x)),
-                               T.fq2_from_limbs(np.asarray(y))))
+        """EC-sum of compressed G2 signatures — decompression (the Fq2
+        square-root exponentiation) and the addition tree fused in one
+        device program, like the pubkey path."""
+        return _decompress_and_aggregate(
+            signatures, enc_len=96, label="signature",
+            parse=decomp.parse_g2_bytes, coord_shape=(2, F.L),
+            agg_jit=_g2_decompress_aggregate_jit,
+            compress=lambda x, y: gt.compress_g2(
+                (T.fq2_from_limbs(np.asarray(x)), T.fq2_from_limbs(np.asarray(y)))),
+            infinity=lambda: gt.compress_g2(None))
 
     # -- signing ------------------------------------------------------------
 

@@ -119,6 +119,128 @@ def _g1_decompress_traced(x_raw, a_flag):
 _g1_decompress_jit = jax.jit(_g1_decompress_traced)
 
 
+# ---------------------------------------------------------------------------
+# G2: Fq2 square root + sign per the oracle's modular_squareroot
+# (crypto/bls12_381.py:430-441, spec bls_signature.md:96-109)
+# ---------------------------------------------------------------------------
+
+def _fq2_mont(v) -> np.ndarray:
+    from . import fq_tower as T
+    return np.asarray(T.fq2_to_limbs(v), dtype=np.int64)
+
+
+def _g2_constants():
+    """Host-precomputed Fq2 constants for the sqrt ladder: the 4 even
+    eighth-roots of unity, the inverses of their square roots (the fourth
+    roots the candidate divides by), and G2_B."""
+    from ..crypto import bls12_381 as gt
+    even_roots = [gt._EIGHTH_ROOTS[k] for k in (0, 2, 4, 6)]
+    fourth_inv = [gt.FQ2_ONE / gt._EIGHTH_ROOTS[k] for k in (0, 1, 2, 3)]
+    return (np.stack([_fq2_mont(r) for r in even_roots]),
+            np.stack([_fq2_mont(r) for r in fourth_inv]),
+            _fq2_mont(gt.G2_B))
+
+
+_SQRT2_EXP_BITS = None   # lazy: bits of (q^2 + 7) // 16
+
+
+def _fq2_pow_static(a, bits_np: np.ndarray):
+    from . import fq_tower as T
+    bits = jnp.asarray(bits_np.astype(np.uint8))
+    n = int(bits_np.shape[0])
+
+    def body(i, acc):
+        acc = T.fq2_sqr(acc)
+        mul = T.fq2_mul(acc, a)
+        return T.fq2_select(bits[i] == 1, mul, acc)
+
+    one = jnp.broadcast_to(T.fq2_ones(()), a.shape)
+    return jax.lax.fori_loop(0, n, body, one)
+
+
+def _fq2_sign_flip(y, a_flag):
+    """Whether to negate `y` so the result equals the oracle's
+    modular_squareroot-then-a_flag composition (bls12_381.py:436-441,
+    417-418). For c1 != 0 the flag condition alone pins the root: final
+    (c1 > (q-1)/2) == a_flag. For c1 == 0 the flag is insensitive (both
+    roots have c1 == 0), so the max-(c1, c0) pick survives and the flip
+    applies on top: final (c0 > (q-1)/2) == NOT a_flag."""
+    raw = F.fq_mul(y, jnp.asarray(_ONE_RAW_NP))
+    c0 = F.fq_canon(raw[..., 0, :])
+    c1 = F.fq_canon(raw[..., 1, :])
+    c1_zero = ~jnp.any(c1 != 0, axis=-1)
+    c0_gt = _fq_gt(c0, _HALF_Q_NP)
+    c1_gt = _fq_gt(c1, _HALF_Q_NP)
+    return jnp.where(c1_zero, c0_gt == a_flag, c1_gt != a_flag)
+
+
+def _g2_decompress_traced(x_raw, a_flag):
+    """x_raw [N, 2, L] raw limbs (c0, c1), a_flag [N] bool ->
+    (x_mont, y_mont [N, 2, L], valid [N] bool)."""
+    from ..crypto import bls12_381 as gt
+    from . import fq_tower as T
+
+    global _SQRT2_EXP_BITS
+    if _SQRT2_EXP_BITS is None:
+        _SQRT2_EXP_BITS = F._exp_bits((gt.q ** 2 + 7) // 16)
+    even_roots, fourth_inv, g2_b = _g2_constants()
+
+    # range check both coordinates < q
+    d0 = F._carry_rounds(x_raw[:, 0] - jnp.asarray(F._Q_NP), F.NORM_FULL)
+    d1 = F._carry_rounds(x_raw[:, 1] - jnp.asarray(F._Q_NP), F.NORM_FULL)
+    x_lt_q = (d0[..., -1] < 0) & (d1[..., -1] < 0)
+
+    r2 = jnp.asarray(_R2_NP)
+    x = T.fq2(F.fq_mul(x_raw[:, 0], r2), F.fq_mul(x_raw[:, 1], r2))
+    y2 = T.fq2_add(T.fq2_mul(T.fq2_sqr(x), x), jnp.asarray(g2_b))
+
+    cand = _fq2_pow_static(y2, _SQRT2_EXP_BITS)      # y2^((q^2+7)/16)
+    check = T.fq2_mul(T.fq2_sqr(cand), T.fq2_inv(y2))
+
+    # which even eighth-root the check equals (if any) selects the fourth
+    # root to divide out; no match = not a square = off curve
+    y = jnp.zeros_like(cand)
+    matched = jnp.zeros(cand.shape[0], dtype=bool)
+    for k in range(4):
+        hit = T.fq2_eq(check, jnp.asarray(even_roots[k]))
+        yk = T.fq2_mul(cand, jnp.asarray(fourth_inv[k]))
+        y = T.fq2_select(hit & ~matched, yk, y)
+        matched = matched | hit
+
+    y = T.fq2_select(_fq2_sign_flip(y, a_flag), T.fq2_neg(y), y)
+    return x, y, x_lt_q & matched
+
+
+_g2_decompress_jit = jax.jit(_g2_decompress_traced)
+
+
+def parse_g2_bytes(data: np.ndarray):
+    """[N, 96] uint8 -> (x_limbs [N, 2, L] raw (c0, c1), a_flag1 [N] bool,
+    is_infinity [N] bool, wellformed [N] bool). The encoding is
+    z1 (flags | x.c1) || z2 (x.c0) — imaginary part first on the wire."""
+    data = np.asarray(data, dtype=np.uint8)
+    c1_limbs, a_flag1, b_flag1, wf1 = parse_g1_bytes(data[:, :48])
+    z2_top_clear = (data[:, 48] & 0xE0) == 0
+    c0_limbs, _, _, _ = parse_g1_bytes(
+        np.concatenate([data[:, 48:49] & 0x1F, data[:, 49:]], axis=1))
+    c0_zero = ~np.any(c0_limbs, axis=1)
+    is_inf = b_flag1
+    wellformed = wf1 & z2_top_clear & (~b_flag1 | c0_zero)
+    x = np.stack([c0_limbs, c1_limbs], axis=1)
+    return x, a_flag1, is_inf, wellformed
+
+
+def g2_decompress_batch(data: np.ndarray):
+    """[N, 96] uint8 -> (x_mont [N, 2, L], y_mont [N, 2, L], valid [N],
+    is_infinity [N]) with the same accept/reject set as the bignum
+    oracle's decompress_g2."""
+    x_raw, a_flag, is_inf, wellformed = parse_g2_bytes(data)
+    x, y, valid = _g2_decompress_jit(x_raw, jnp.asarray(a_flag))
+    valid = np.asarray(valid) & wellformed & ~is_inf
+    valid = valid | (wellformed & is_inf)
+    return x, y, valid, is_inf
+
+
 def g1_decompress_batch(data: np.ndarray):
     """[N, 48] uint8 -> (x_mont [N, L], y_mont [N, L], valid [N] bool,
     is_infinity [N] bool).

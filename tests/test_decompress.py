@@ -86,3 +86,112 @@ def test_large_batch_matches():
     got = _batch(encodings)
     want = [_oracle(bytes(e)) for e in encodings]
     assert got == want
+
+
+# ---------------------------------------------------------------------------
+# G2 (decompress_g2 oracle: crypto/bls12_381.py:398-419, Fq2 sqrt :430-441)
+# ---------------------------------------------------------------------------
+
+def _g2_oracle(data: bytes):
+    try:
+        return gt.decompress_g2(data)
+    except AssertionError:
+        return "invalid"
+
+
+def _g2_batch(encodings):
+    from consensus_specs_tpu.ops import fq_tower as T
+    data = np.stack([np.frombuffer(e, np.uint8) for e in encodings])
+    x, y, valid, inf = D.g2_decompress_batch(data)
+    out = []
+    for i in range(len(encodings)):
+        if not valid[i]:
+            out.append("invalid")
+        elif inf[i]:
+            out.append(None)
+        else:
+            out.append((T.fq2_from_limbs(np.asarray(x)[i]),
+                        T.fq2_from_limbs(np.asarray(y)[i])))
+    return out
+
+
+def test_g2_valid_points_match_oracle():
+    encodings = [gt.compress_g2(gt.ec_mul(gt.G2_GEN, k)) for k in range(1, 7)]
+    assert _g2_batch(encodings) == [_g2_oracle(e) for e in encodings]
+
+
+def test_g2_infinity_and_malformed():
+    good = gt.compress_g2(gt.ec_mul(gt.G2_GEN, 5))
+    inf = gt.compress_g2(None)
+    cases = [
+        inf,
+        bytes([good[0] & 0x7F]) + good[1:],           # c_flag unset
+        bytes([0xE0]) + b"\x00" * 95,                 # infinity with a_flag
+        bytes([0xC0]) + b"\x00" * 46 + b"\x01" + b"\x00" * 48,  # inf, x1 != 0
+        bytes([0xC0]) + b"\x00" * 47 + b"\x01" + b"\x00" * 47,  # inf, x2 != 0
+        good[:48] + bytes([0x80]) + good[49:],        # z2 flag bits set
+    ]
+    got = _g2_batch(cases)
+    want = [_g2_oracle(c) for c in cases]
+    assert got == want
+    assert want[0] is None and all(v == "invalid" for v in want[1:])
+
+
+def test_g2_both_signs_and_offcurve():
+    x, y = gt.ec_mul(gt.G2_GEN, 9)
+    enc_pos = gt.compress_g2((x, y))
+    enc_neg = gt.compress_g2((x, -y))
+    # an x2 whose y2 is a non-square: probe small reals with zero imaginary
+    bad = None
+    for c0 in range(2, 60):
+        probe = bytearray(96)
+        probe[0] = 0x80
+        probe[48:] = c0.to_bytes(48, "big")
+        if _g2_oracle(bytes(probe)) == "invalid":
+            bad = bytes(probe)
+            break
+    assert bad is not None
+    got = _g2_batch([enc_pos, enc_neg, bad])
+    want = [_g2_oracle(enc_pos), _g2_oracle(enc_neg), "invalid"]
+    assert got == want
+    assert got[0] != got[1]
+
+
+def test_g2_real_y_sign_branch():
+    """Adversarial encodings whose y has ZERO imaginary part: the a_flag is
+    insensitive there (both roots have c1 == 0), so the sign comes from the
+    oracle's max-(c1, c0) rule composed with the flag flip — the
+    flag-insensitive branch of ops/decompress._fq2_sign_flip. Constructed
+    algebraically: choose x = a + bi with (x^3 + B).c1 == 0, i.e.
+    3a^2 b - b^3 + 4 == 0 -> a^2 = (b^3 - 4) / (3b)."""
+    q = gt.q
+    found = []
+    for b in range(1, 80):
+        a2 = (b ** 3 - 4) * pow(3 * b, q - 2, q) % q
+        if pow(a2, (q - 1) // 2, q) != 1:
+            continue                      # a not in Fq
+        a = pow(a2, (q + 1) // 4, q)
+        x = gt.Fq2(a, b)
+        y2 = x * x * x + gt.G2_B
+        assert y2.c1 == 0
+        y = gt.modular_squareroot(y2)
+        if y is None:
+            continue                      # not a square at all
+        if y.c1 != 0:
+            continue                      # root came out purely imaginary
+        assert y.c0 != 0
+        found.append(x)
+        if len(found) == 2:
+            break
+    assert found, "construction must yield real-y points"
+    cases = []
+    for x in found:
+        for flag in (0, 1):
+            z1 = (x.c1 | (1 << 383) | (flag << 381)).to_bytes(48, "big")
+            cases.append(z1 + x.c0.to_bytes(48, "big"))
+    got = _g2_batch(cases)
+    want = [_g2_oracle(c) for c in cases]
+    assert got == want
+    for k in range(0, len(cases), 2):     # the two flags give distinct roots
+        assert got[k] != got[k + 1]
+        assert got[k][1].c1 == 0 == got[k + 1][1].c1
