@@ -199,6 +199,68 @@ def generalized_index_for_path(value: Any, typ: Any,
     raise TypeError(f"cannot path into {typ}")
 
 
+def generalized_index_for_typed_path(typ: Any, path: Sequence[Union[str, int]],
+                                     list_lengths: Dict[tuple, int],
+                                     _prefix: tuple = ()) -> int:
+    """Value-free twin of generalized_index_for_path for VERIFIERS: the
+    client has no object, only the type and (for Lists) lengths it learned
+    from proven length leaves — `list_lengths[path_prefix]`. Vector and
+    container widths are static. Must agree index-for-index with the
+    value-based function (asserted in tests); a verifier that trusts the
+    prover's indices instead of recomputing them accepts forged
+    record/seed substitutions."""
+    if not path:
+        return 1
+    head, rest = path[0], path[1:]
+
+    if is_list_kind(typ) and not is_bytesn_type(typ):
+        if head == LENGTH_FLAG or head == "__len__":
+            assert not rest
+            return 3
+        length = list_lengths[_prefix]
+        elem = getattr(typ, "elem_type", None)
+        if typ is bytes or elem is None:
+            assert not rest
+            return _compose(2, _pow2_at_least((length + 31) // 32) + head // 32)
+        if is_basic_type(elem):
+            per_chunk = 32 // uint_byte_size(elem) if is_uint_type(elem) else 32
+            count = (length + per_chunk - 1) // per_chunk
+            assert not rest
+            return _compose(2, _pow2_at_least(count) + head // per_chunk)
+        width = _pow2_at_least(length)
+        return _compose(2, _compose(
+            width + head,
+            generalized_index_for_typed_path(elem, rest, list_lengths,
+                                             _prefix + (head,))))
+
+    if is_container_type(typ):
+        names = typ.get_field_names()
+        position = names.index(head)
+        width = _pow2_at_least(len(names))
+        sub_typ = typ.get_field_types()[position]
+        return _compose(width + position,
+                        generalized_index_for_typed_path(
+                            sub_typ, rest, list_lengths, _prefix + (head,)))
+
+    if is_vector_type(typ):
+        elem = typ.elem_type
+        if is_basic_type(elem):
+            per_chunk = 32 // uint_byte_size(elem) if is_uint_type(elem) else 32
+            count = (typ.length + per_chunk - 1) // per_chunk
+            assert not rest
+            return _pow2_at_least(count) + head // per_chunk
+        width = _pow2_at_least(typ.length)
+        return _compose(width + head,
+                        generalized_index_for_typed_path(
+                            elem, rest, list_lengths, _prefix + (head,)))
+
+    if is_bytesn_type(typ):
+        assert not rest
+        return _pow2_at_least((typ.length + 31) // 32) + head // 32
+
+    raise TypeError(f"cannot path into {typ}")
+
+
 # ---------------------------------------------------------------------------
 # Multiproofs
 # ---------------------------------------------------------------------------

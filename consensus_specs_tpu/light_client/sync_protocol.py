@@ -222,3 +222,101 @@ def build_validator_memory(spec, state, slot: int,
         earlier_period_data=get_period_data(spec, state, slot, shard_id, later=False),
         later_period_data=get_period_data(spec, state, slot, shard_id, later=True),
     )
+
+
+# ---------------------------------------------------------------------------
+# Authenticated committee updates: PeriodData as a Merkle partial
+# (sync_protocol.md:108-117 — "ask the network for new_committee_proof =
+#  MerklePartial(get_period_data, ...)"; proof machinery:
+#  light_client/multiproof.py per merkle_proofs.md:106-187)
+# ---------------------------------------------------------------------------
+
+def _seed_input_paths(spec, period_start: int):
+    """The two state leaves generate_seed reads for `period_start`
+    (models/phase0/helpers.py:184-193): the randao mix at epoch + LEN -
+    MIN_SEED_LOOKAHEAD, and the active-index root at epoch (no offset)."""
+    return [
+        ["latest_randao_mixes",
+         (period_start + spec.LATEST_RANDAO_MIXES_LENGTH
+          - spec.MIN_SEED_LOOKAHEAD) % spec.LATEST_RANDAO_MIXES_LENGTH],
+        ["latest_active_index_roots",
+         period_start % spec.LATEST_ACTIVE_INDEX_ROOTS_LENGTH],
+    ]
+
+
+def prove_period_data(spec, state, slot: int, shard_id: int, later: bool):
+    """(PeriodData, MerklePartial) — the partial authenticates, against
+    hash_tree_root(state), every committee member's validator record, the
+    registry length (so the verifier can recompute list indices), and the
+    seed inputs generate_seed reads. A client holding only a finalized
+    state root can thus verify the shipped records and recompute the seed;
+    the index-list -> span mapping itself needs the doc's
+    ExtendedBeaconState expansion (latest_active_indices), which is a
+    re-interpretation of the same root, not extra proof material
+    (sync_protocol.md:28-35)."""
+    from ..utils.ssz.impl import hash_tree_root
+    from .multiproof import (LENGTH_FLAG, SSZMerkleTree,
+                             generalized_index_for_path)
+
+    pd = get_period_data(spec, state, slot, shard_id, later)
+    period_start = (get_later_start_epoch(spec, slot) if later
+                    else get_earlier_start_epoch(spec, slot))
+    typ = spec.BeaconState
+    tree = SSZMerkleTree(state, typ)
+    paths = [["validator_registry", LENGTH_FLAG]]
+    paths += [["validator_registry", i] for i in sorted(pd.validators)]
+    paths += _seed_input_paths(spec, period_start)
+    indices = [generalized_index_for_path(state, typ, p) for p in paths]
+    partial = tree.prove(indices)
+    assert partial.root == hash_tree_root(state, typ)
+    return pd, partial
+
+
+def verify_period_data(spec, state_root: bytes, period_data: PeriodData,
+                       partial, slot: int, later: bool) -> bool:
+    """Client side. The proven generalized indices are RECOMPUTED from the
+    type layout and the proven registry length — never taken from the
+    prover (a verifier that trusts the prover's indices accepts record and
+    seed substitutions against an honest root). Then: every shipped
+    validator record must hash to its proven leaf, and the seed recomputed
+    from the proven randao mix + active-index root must equal the
+    PeriodData's. Returns False on any mismatch."""
+    from ..utils.ssz.impl import hash_tree_root
+    from .multiproof import LENGTH_FLAG, generalized_index_for_typed_path
+
+    try:
+        if bytes(partial.root) != bytes(state_root) or not partial.verify():
+            return False
+        typ = spec.BeaconState
+        values = dict(zip(partial.indices, partial.values))
+        # step 1: the registry length from its (position-independent) leaf
+        len_gidx = generalized_index_for_typed_path(
+            typ, ["validator_registry", LENGTH_FLAG], {})
+        if len_gidx not in values:
+            return False
+        registry_len = int.from_bytes(values[len_gidx][:8], "little")
+        lengths = {("validator_registry",): registry_len}
+        # step 2: recompute EVERY expected index and demand exact agreement
+        period_start = (get_later_start_epoch(spec, slot) if later
+                        else get_earlier_start_epoch(spec, slot))
+        members = sorted(period_data.validators)
+        if any(i >= registry_len for i in members):
+            return False
+        paths = [["validator_registry", LENGTH_FLAG]]
+        paths += [["validator_registry", i] for i in members]
+        paths += _seed_input_paths(spec, period_start)
+        expected = [generalized_index_for_typed_path(typ, p, lengths)
+                    for p in paths]
+        if expected != list(partial.indices):
+            return False
+        # step 3: record authenticity against the now-pinned indices
+        for i, member in enumerate(members):
+            record = period_data.validators[member]
+            if hash_tree_root(record, spec.Validator) != values[expected[1 + i]]:
+                return False
+        # step 4: seed chain of custody
+        mix, air = values[expected[-2]], values[expected[-1]]
+        seed = spec.hash(mix + air + spec.int_to_bytes(period_start, length=32))
+        return seed == period_data.seed
+    except (AssertionError, KeyError, IndexError, ValueError, TypeError):
+        return False

@@ -144,3 +144,110 @@ def test_block_validity_proof_rejects_tampering(spec, state):
         assert not sp.verify_block_validity_proof(spec, bad_sig, memory)
     finally:
         bls.bls_active = old
+
+
+def test_period_data_merkle_partial_roundtrip(spec, state):
+    """The committee-update proof (sync_protocol.md:108-117): PeriodData
+    ships with a multiproof a client verifies against the finalized state
+    root alone — record hashes and the seed's inputs included."""
+    from consensus_specs_tpu.utils.ssz.impl import hash_tree_root
+
+    # make every randao-mix / active-index-root entry distinct so proving
+    # the WRONG leaf cannot accidentally verify (genesis fills them all
+    # with identical values, which once masked an off-by-delay bug here)
+    for j in range(spec.LATEST_RANDAO_MIXES_LENGTH):
+        state.latest_randao_mixes[j] = bytes([j]) * 32
+    for j in range(spec.LATEST_ACTIVE_INDEX_ROOTS_LENGTH):
+        state.latest_active_index_roots[j] = bytes([0x40 | j]) * 32
+
+    root = hash_tree_root(state, spec.BeaconState)
+    pd, partial = sp.prove_period_data(spec, state, slot=0, shard_id=2,
+                                       later=True)
+    assert sp.verify_period_data(spec, root, pd, partial, slot=0, later=True)
+
+    # tampered state root
+    assert not sp.verify_period_data(spec, b"\xee" * 32, pd, partial,
+                                     slot=0, later=True)
+    # tampered record (server lies about a member's balance)
+    import copy
+    pd_bad = copy.deepcopy(pd)
+    victim = sorted(pd_bad.validators)[0]
+    pd_bad.validators[victim].effective_balance += 1
+    assert not sp.verify_period_data(spec, root, pd_bad, partial,
+                                     slot=0, later=True)
+    # tampered seed
+    pd_bad2 = copy.deepcopy(pd)
+    pd_bad2.seed = b"\x55" * 32
+    assert not sp.verify_period_data(spec, root, pd_bad2, partial,
+                                     slot=0, later=True)
+    # tampered proof leaf
+    partial.values[0] = b"\x99" * 32
+    assert not sp.verify_period_data(spec, root, pd, partial,
+                                     slot=0, later=True)
+
+
+def test_period_data_proof_forgeries_rejected(spec, state):
+    """The two executable forgeries from review: (a) proving a DIFFERENT
+    validator's registry leaf under a claimed member, (b) proving arbitrary
+    tree nodes as the seed inputs and deriving the seed from them. Both
+    verify as multiproofs against the honest root; both must fail
+    verify_period_data's index recomputation."""
+    import copy
+
+    from consensus_specs_tpu.light_client.multiproof import (
+        LENGTH_FLAG, SSZMerkleTree, generalized_index_for_path)
+    from consensus_specs_tpu.utils.ssz.impl import hash_tree_root
+
+    root = hash_tree_root(state, spec.BeaconState)
+    pd, _ = sp.prove_period_data(spec, state, slot=0, shard_id=2, later=True)
+    members = sorted(pd.validators)
+    outsider = next(i for i in range(len(state.validator_registry))
+                    if i not in pd.validators)
+    tree = SSZMerkleTree(state, spec.BeaconState)
+
+    # (a) record substitution: claim member V holds the outsider's record,
+    # prove the outsider's leaf in V's position
+    victim = members[0]
+    pd_forged = copy.deepcopy(pd)
+    pd_forged.validators[victim] = state.validator_registry[outsider]
+    paths = [["validator_registry", LENGTH_FLAG]]
+    paths += [["validator_registry", outsider if i == victim else i]
+              for i in members]
+    period_start = sp.get_later_start_epoch(spec, 0)
+    paths += sp._seed_input_paths(spec, period_start)
+    forged = tree.prove([generalized_index_for_path(state, spec.BeaconState, p)
+                         for p in paths])
+    assert forged.verify()   # it IS a valid multiproof of the honest root
+    assert not sp.verify_period_data(spec, root, pd_forged, forged,
+                                     slot=0, later=True)
+
+    # (b) seed forgery: prove two registry leaves in the seed-input slots
+    # and derive the claimed seed from them
+    paths = [["validator_registry", LENGTH_FLAG]]
+    paths += [["validator_registry", i] for i in members]
+    paths += [["validator_registry", outsider],
+              ["validator_registry", (outsider + 1) % len(state.validator_registry)]]
+    idxs = [generalized_index_for_path(state, spec.BeaconState, p) for p in paths]
+    forged2 = tree.prove(idxs)
+    assert forged2.verify()
+    pd_forged2 = copy.deepcopy(pd)
+    pd_forged2.seed = spec.hash(forged2.value_at(idxs[-2])
+                                + forged2.value_at(idxs[-1])
+                                + spec.int_to_bytes(period_start, length=32))
+    assert not sp.verify_period_data(spec, root, pd_forged2, forged2,
+                                     slot=0, later=True)
+
+
+def test_typed_path_indices_agree_with_value_paths(spec, state):
+    from consensus_specs_tpu.light_client.multiproof import (
+        LENGTH_FLAG, generalized_index_for_path, generalized_index_for_typed_path)
+    lengths = {("validator_registry",): len(state.validator_registry)}
+    paths = ([["validator_registry", LENGTH_FLAG],
+              ["validator_registry", 0],
+              ["validator_registry", 7],
+              ["latest_randao_mixes", 3],
+              ["latest_active_index_roots", 1],
+              ["fork"], ["slot"]])
+    for p in paths:
+        assert generalized_index_for_typed_path(spec.BeaconState, p, lengths) \
+            == generalized_index_for_path(state, spec.BeaconState, p), p
