@@ -21,8 +21,8 @@ from ..utils.ssz.impl import (
     chunkify, hash_tree_root, is_basic_type, is_bottom_layer_kind, pack,
     serialize_basic)
 from ..utils.ssz.typing import (
-    is_bytesn_type, is_container_type, is_list_kind, is_list_type,
-    is_uint_type, is_vector_type, read_elem_type, uint_byte_size)
+    is_bytesn_type, is_container_type, is_list_kind, is_uint_type,
+    is_vector_type, read_elem_type, uint_byte_size)
 
 LENGTH_FLAG = 2 ** 64 - 1   # path element selecting len(list)
 
@@ -146,7 +146,42 @@ def generalized_index_for_path(value: Any, typ: Any,
                                path: Sequence[Union[str, int]]) -> int:
     """Generalized index of the node a human-readable path selects:
     field names for containers, integers for vector/list elements,
-    LENGTH_FLAG for a list's length mix-in."""
+    LENGTH_FLAG for a list's length mix-in.
+
+    Thin wrapper over the value-free core: walks the value once to read
+    the list lengths the path crosses, then delegates — prover and
+    verifier therefore share ONE index computation by construction."""
+    lengths: Dict[tuple, int] = {}
+    v, t, prefix = value, typ, ()
+    for head in path:
+        if is_container_type(t):
+            sub = t.get_field_names().index(head)
+            v, t = getattr(v, head), t.get_field_types()[sub]
+        elif is_list_kind(t):
+            if head == LENGTH_FLAG or head == "__len__":
+                break
+            lengths[prefix] = len(v)
+            if t is bytes or is_basic_type(t.elem_type):
+                break
+            v, t = v[head], t.elem_type
+        elif is_vector_type(t):
+            if is_basic_type(t.elem_type):
+                break
+            v, t = v[head], t.elem_type
+        else:   # BytesN leaf
+            break
+        prefix = prefix + (head,)
+    return generalized_index_for_typed_path(typ, path, lengths)
+
+
+def generalized_index_for_typed_path(typ: Any, path: Sequence[Union[str, int]],
+                                     list_lengths: Dict[tuple, int],
+                                     _prefix: tuple = ()) -> int:
+    """Value-free index computation — the core both sides share. The
+    caller supplies `list_lengths[path_prefix]` for every List the path
+    crosses (a VERIFIER reads them from proven length leaves; the prover
+    wrapper above reads them from the object). Vector/container widths are
+    static from the type."""
     if not path:
         return 1
     head, rest = path[0], path[1:]
@@ -155,73 +190,11 @@ def generalized_index_for_path(value: Any, typ: Any,
         if head == LENGTH_FLAG or head == "__len__":
             assert not rest
             return 3
+        length = list_lengths[_prefix]
         if typ is bytes:
             assert not rest
-            width = _pow2_at_least((len(value) + 31) // 32)
-            return _compose(2, width + head // 32)
-        elem = typ.elem_type
-        if is_basic_type(elem):
-            per_chunk = 32 // uint_byte_size(elem) if is_uint_type(elem) else 32
-            count = (len(value) + per_chunk - 1) // per_chunk
-            assert not rest, "basic elements have no sub-paths"
-            return _compose(2, _pow2_at_least(count) + head // per_chunk)
-        width = _pow2_at_least(len(value))
-        return _compose(2, _compose(
-            width + head, generalized_index_for_path(value[head], elem, rest)))
-
-    if is_container_type(typ):
-        names = typ.get_field_names()
-        position = names.index(head)
-        width = _pow2_at_least(len(names))
-        sub_typ = typ.get_field_types()[position]
-        sub_val = getattr(value, head)
-        return _compose(width + position,
-                        generalized_index_for_path(sub_val, sub_typ, rest))
-
-    if is_vector_type(typ) or is_list_type(typ):
-        elem = typ.elem_type
-        if is_basic_type(elem):
-            per_chunk = 32 // uint_byte_size(elem) if is_uint_type(elem) else 32
-            count = (len(value) + per_chunk - 1) // per_chunk
-            assert not rest, "basic elements have no sub-paths"
-            width = _pow2_at_least(count)
-            return width + head // per_chunk
-        count = len(value)
-        width = _pow2_at_least(count)
-        return _compose(width + head,
-                        generalized_index_for_path(value[head], elem, rest))
-
-    if is_bytesn_type(typ) or typ is bytes:
-        assert not rest
-        width = _pow2_at_least((len(value) + 31) // 32)
-        return width + head // 32
-
-    raise TypeError(f"cannot path into {typ}")
-
-
-def generalized_index_for_typed_path(typ: Any, path: Sequence[Union[str, int]],
-                                     list_lengths: Dict[tuple, int],
-                                     _prefix: tuple = ()) -> int:
-    """Value-free twin of generalized_index_for_path for VERIFIERS: the
-    client has no object, only the type and (for Lists) lengths it learned
-    from proven length leaves — `list_lengths[path_prefix]`. Vector and
-    container widths are static. Must agree index-for-index with the
-    value-based function (asserted in tests); a verifier that trusts the
-    prover's indices instead of recomputing them accepts forged
-    record/seed substitutions."""
-    if not path:
-        return 1
-    head, rest = path[0], path[1:]
-
-    if is_list_kind(typ) and not is_bytesn_type(typ):
-        if head == LENGTH_FLAG or head == "__len__":
-            assert not rest
-            return 3
-        length = list_lengths[_prefix]
-        elem = getattr(typ, "elem_type", None)
-        if typ is bytes or elem is None:
-            assert not rest
             return _compose(2, _pow2_at_least((length + 31) // 32) + head // 32)
+        elem = typ.elem_type
         if is_basic_type(elem):
             per_chunk = 32 // uint_byte_size(elem) if is_uint_type(elem) else 32
             count = (length + per_chunk - 1) // per_chunk

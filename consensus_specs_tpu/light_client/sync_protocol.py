@@ -244,16 +244,28 @@ def _seed_input_paths(spec, period_start: int):
     ]
 
 
-def prove_period_data(spec, state, slot: int, shard_id: int, later: bool):
-    """(PeriodData, MerklePartial) — the partial authenticates, against
+@dataclass
+class PeriodDataProof:
+    """Everything a client needs to authenticate a PeriodData against a
+    finalized state root: the multiproof plus the ExtendedBeaconState
+    expansion of the active-index-root leaf (sync_protocol.md:28-46 — the
+    expansion is a re-interpretation of a committed root, so shipping the
+    list adds data but no trust; a production server would ship only the
+    shard's contiguous slice of it, sync_protocol.md:112)."""
+    partial: object                 # MerklePartial over the BeaconState
+    active_indices: List[int]       # expansion of the proven index root
+
+
+def prove_period_data(spec, state, slot: int, shard_id: int, later: bool,
+                      tree=None):
+    """(PeriodData, PeriodDataProof). The partial authenticates, against
     hash_tree_root(state), every committee member's validator record, the
     registry length (so the verifier can recompute list indices), and the
-    seed inputs generate_seed reads. A client holding only a finalized
-    state root can thus verify the shipped records and recompute the seed;
-    the index-list -> span mapping itself needs the doc's
-    ExtendedBeaconState expansion (latest_active_indices), which is a
-    re-interpretation of the same root, not extra proof material
-    (sync_protocol.md:28-35)."""
+    seed inputs generate_seed reads — the active-index-root leaf doubles
+    as the commitment the shipped active_indices expansion must hash to.
+    Pass a prebuilt SSZMerkleTree(state) via `tree` to amortize the full-
+    state hashing across the earlier/later pair (build_validator_memory's
+    shape) and across clients."""
     from ..utils.ssz.impl import hash_tree_root
     from .multiproof import (LENGTH_FLAG, SSZMerkleTree,
                              generalized_index_for_path)
@@ -262,45 +274,57 @@ def prove_period_data(spec, state, slot: int, shard_id: int, later: bool):
     period_start = (get_later_start_epoch(spec, slot) if later
                     else get_earlier_start_epoch(spec, slot))
     typ = spec.BeaconState
-    tree = SSZMerkleTree(state, typ)
+    if tree is None:
+        tree = SSZMerkleTree(state, typ)
     paths = [["validator_registry", LENGTH_FLAG]]
     paths += [["validator_registry", i] for i in sorted(pd.validators)]
     paths += _seed_input_paths(spec, period_start)
     indices = [generalized_index_for_path(state, typ, p) for p in paths]
     partial = tree.prove(indices)
     assert partial.root == hash_tree_root(state, typ)
-    return pd, partial
+    active = [int(i) for i in
+              spec.get_active_validator_indices(state, period_start)]
+    return pd, PeriodDataProof(partial=partial, active_indices=active)
 
 
 def verify_period_data(spec, state_root: bytes, period_data: PeriodData,
-                       partial, slot: int, later: bool) -> bool:
-    """Client side. The proven generalized indices are RECOMPUTED from the
-    type layout and the proven registry length — never taken from the
-    prover (a verifier that trusts the prover's indices accepts record and
-    seed substitutions against an honest root). Then: every shipped
-    validator record must hash to its proven leaf, and the seed recomputed
-    from the proven randao mix + active-index root must equal the
-    PeriodData's. Returns False on any mismatch."""
+                       proof: PeriodDataProof, slot: int, shard_id: int,
+                       later: bool) -> bool:
+    """Client side — full chain of custody from the finalized state root:
+
+    1. the multiproof verifies, and every proven generalized index is
+       RECOMPUTED from the type layout + the proven registry length —
+       never taken from the prover (trusting the prover's indices accepts
+       record and seed substitutions against an honest root);
+    2. every shipped validator record hashes to its proven leaf;
+    3. the seed recomputes from the proven randao mix + active-index root;
+    4. the shipped active-index expansion hashes to that same proven
+       index-root leaf, and the committee span + validator_count recompute
+       from it — so a True here covers EVERY field compute_committee
+       consumes; a forged span cannot ride an honest proof.
+
+    Returns False on any mismatch."""
     from ..utils.ssz.impl import hash_tree_root
+    from ..utils.ssz.typing import List as SSZList, uint64
     from .multiproof import LENGTH_FLAG, generalized_index_for_typed_path
 
+    partial = proof.partial
     try:
         if bytes(partial.root) != bytes(state_root) or not partial.verify():
             return False
         typ = spec.BeaconState
         values = dict(zip(partial.indices, partial.values))
-        # step 1: the registry length from its (position-independent) leaf
+        # step 1: pin the indices
         len_gidx = generalized_index_for_typed_path(
             typ, ["validator_registry", LENGTH_FLAG], {})
         if len_gidx not in values:
             return False
         registry_len = int.from_bytes(values[len_gidx][:8], "little")
         lengths = {("validator_registry",): registry_len}
-        # step 2: recompute EVERY expected index and demand exact agreement
         period_start = (get_later_start_epoch(spec, slot) if later
                         else get_earlier_start_epoch(spec, slot))
         members = sorted(period_data.validators)
-        if any(i >= registry_len for i in members):
+        if any(not 0 <= i < registry_len for i in members):
             return False
         paths = [["validator_registry", LENGTH_FLAG]]
         paths += [["validator_registry", i] for i in members]
@@ -309,14 +333,25 @@ def verify_period_data(spec, state_root: bytes, period_data: PeriodData,
                     for p in paths]
         if expected != list(partial.indices):
             return False
-        # step 3: record authenticity against the now-pinned indices
+        # step 2: record authenticity against the now-pinned indices
         for i, member in enumerate(members):
             record = period_data.validators[member]
             if hash_tree_root(record, spec.Validator) != values[expected[1 + i]]:
                 return False
-        # step 4: seed chain of custody
+        # step 3: seed chain of custody
         mix, air = values[expected[-2]], values[expected[-1]]
         seed = spec.hash(mix + air + spec.int_to_bytes(period_start, length=32))
-        return seed == period_data.seed
+        if seed != period_data.seed:
+            return False
+        # step 4: span + count from the authenticated expansion
+        active = [int(i) for i in proof.active_indices]
+        if hash_tree_root(active, SSZList[uint64]) != air:
+            return False
+        if period_data.validator_count != len(active):
+            return False
+        span = _shard_span(spec, active, seed, shard_id)
+        if span != list(period_data.committee):
+            return False
+        return set(period_data.validators) == set(span)
     except (AssertionError, KeyError, IndexError, ValueError, TypeError):
         return False

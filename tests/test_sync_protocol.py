@@ -152,38 +152,60 @@ def test_period_data_merkle_partial_roundtrip(spec, state):
     root alone — record hashes and the seed's inputs included."""
     from consensus_specs_tpu.utils.ssz.impl import hash_tree_root
 
-    # make every randao-mix / active-index-root entry distinct so proving
-    # the WRONG leaf cannot accidentally verify (genesis fills them all
-    # with identical values, which once masked an off-by-delay bug here)
+    # make every randao-mix entry distinct, and every active-index-root
+    # entry EXCEPT the true period-start position garbage, so proving the
+    # WRONG leaf cannot accidentally verify (genesis fills them all with
+    # identical values, which once masked an off-by-delay bug here). The
+    # correct position must hold the real commitment: verify_period_data
+    # hashes the shipped expansion against that exact leaf.
+    from consensus_specs_tpu.utils.ssz.typing import List as SSZList, uint64
     for j in range(spec.LATEST_RANDAO_MIXES_LENGTH):
         state.latest_randao_mixes[j] = bytes([j]) * 32
     for j in range(spec.LATEST_ACTIVE_INDEX_ROOTS_LENGTH):
         state.latest_active_index_roots[j] = bytes([0x40 | j]) * 32
+    period_start = sp.get_later_start_epoch(spec, 0)
+    active = [int(i) for i in spec.get_active_validator_indices(state, period_start)]
+    state.latest_active_index_roots[
+        period_start % spec.LATEST_ACTIVE_INDEX_ROOTS_LENGTH] = \
+        hash_tree_root(active, SSZList[uint64])
 
     root = hash_tree_root(state, spec.BeaconState)
-    pd, partial = sp.prove_period_data(spec, state, slot=0, shard_id=2,
-                                       later=True)
-    assert sp.verify_period_data(spec, root, pd, partial, slot=0, later=True)
+    pd, proof = sp.prove_period_data(spec, state, slot=0, shard_id=2,
+                                     later=True)
+    ok = sp.verify_period_data(spec, root, pd, proof, slot=0, shard_id=2,
+                               later=True)
+    assert ok
 
     # tampered state root
-    assert not sp.verify_period_data(spec, b"\xee" * 32, pd, partial,
-                                     slot=0, later=True)
+    assert not sp.verify_period_data(spec, b"\xee" * 32, pd, proof,
+                                     slot=0, shard_id=2, later=True)
     # tampered record (server lies about a member's balance)
     import copy
     pd_bad = copy.deepcopy(pd)
     victim = sorted(pd_bad.validators)[0]
     pd_bad.validators[victim].effective_balance += 1
-    assert not sp.verify_period_data(spec, root, pd_bad, partial,
-                                     slot=0, later=True)
+    assert not sp.verify_period_data(spec, root, pd_bad, proof,
+                                     slot=0, shard_id=2, later=True)
     # tampered seed
     pd_bad2 = copy.deepcopy(pd)
     pd_bad2.seed = b"\x55" * 32
-    assert not sp.verify_period_data(spec, root, pd_bad2, partial,
-                                     slot=0, later=True)
+    assert not sp.verify_period_data(spec, root, pd_bad2, proof,
+                                     slot=0, shard_id=2, later=True)
+    # forged committee span riding the honest proof (records/seed intact)
+    pd_bad3 = copy.deepcopy(pd)
+    pd_bad3.committee = sorted(pd_bad3.committee)
+    if pd_bad3.committee != pd.committee:
+        assert not sp.verify_period_data(spec, root, pd_bad3, proof,
+                                         slot=0, shard_id=2, later=True)
+    # forged active-index expansion (wrong count)
+    proof_bad = copy.deepcopy(proof)
+    proof_bad.active_indices = proof.active_indices[:-1]
+    assert not sp.verify_period_data(spec, root, pd, proof_bad,
+                                     slot=0, shard_id=2, later=True)
     # tampered proof leaf
-    partial.values[0] = b"\x99" * 32
-    assert not sp.verify_period_data(spec, root, pd, partial,
-                                     slot=0, later=True)
+    proof.partial.values[0] = b"\x99" * 32
+    assert not sp.verify_period_data(spec, root, pd, proof,
+                                     slot=0, shard_id=2, later=True)
 
 
 def test_period_data_proof_forgeries_rejected(spec, state):
@@ -218,8 +240,10 @@ def test_period_data_proof_forgeries_rejected(spec, state):
     forged = tree.prove([generalized_index_for_path(state, spec.BeaconState, p)
                          for p in paths])
     assert forged.verify()   # it IS a valid multiproof of the honest root
-    assert not sp.verify_period_data(spec, root, pd_forged, forged,
-                                     slot=0, later=True)
+    active = [int(i) for i in spec.get_active_validator_indices(state, period_start)]
+    assert not sp.verify_period_data(
+        spec, root, pd_forged, sp.PeriodDataProof(forged, active),
+        slot=0, shard_id=2, later=True)
 
     # (b) seed forgery: prove two registry leaves in the seed-input slots
     # and derive the claimed seed from them
@@ -234,8 +258,9 @@ def test_period_data_proof_forgeries_rejected(spec, state):
     pd_forged2.seed = spec.hash(forged2.value_at(idxs[-2])
                                 + forged2.value_at(idxs[-1])
                                 + spec.int_to_bytes(period_start, length=32))
-    assert not sp.verify_period_data(spec, root, pd_forged2, forged2,
-                                     slot=0, later=True)
+    assert not sp.verify_period_data(
+        spec, root, pd_forged2, sp.PeriodDataProof(forged2, active),
+        slot=0, shard_id=2, later=True)
 
 
 def test_typed_path_indices_agree_with_value_paths(spec, state):
