@@ -20,7 +20,8 @@ FAMILIES = {
     "sanity": suites.sanity_creators,
     "shuffling": lambda: [suites.shuffling_suite],
     "bls": suites.bls_creators,
-    "ssz_static": lambda: [suites.ssz_static_suite],
+    "ssz_static": lambda: [suites.ssz_static_suite,
+                           suites.ssz_static_phase1_suite],
     "ssz_generic": lambda: [suites.ssz_generic_suite],
 }
 

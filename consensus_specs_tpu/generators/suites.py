@@ -209,10 +209,19 @@ _SSZ_MODES = [
 ]
 
 
-def ssz_static_suite(preset: str) -> Suite:
-    """Serialized bytes + roots for randomized instances of every phase-0
-    container (format: specs/test_formats/ssz_static/core.md)."""
-    spec = phase0.get_spec(preset)
+def ssz_static_suite(preset: str, phase: str = "phase0") -> Suite:
+    """Serialized bytes + roots for randomized instances of every container
+    of the given phase's spec (format: specs/test_formats/ssz_static/
+    core.md). The phase-1 family covers the field-appended
+    Validator/BeaconState/BeaconBlockBody plus the custody and shard
+    containers."""
+    if phase == "phase0":
+        spec = phase0.get_spec(preset)
+    elif phase == "phase1":
+        from ..models import phase1
+        spec = phase1.get_spec(preset)
+    else:
+        raise KeyError(f"unknown phase {phase!r}")
     rng = Random(412)
     cases: List[dict] = []
     for name in sorted(spec.container_types.keys()):
@@ -231,13 +240,18 @@ def ssz_static_suite(preset: str) -> Suite:
                     entry["signing_root"] = "0x" + signing_root(obj, typ).hex()
                 cases.append(entry)
     return Suite(
-        title="SSZ static",
+        title=f"SSZ static ({phase})",
         summary="Randomized serialization/Merkleization vectors per container",
         config=preset,
         runner="ssz_static",
-        handler="core",
+        handler="core" if phase == "phase0" else f"core_{phase}",
+        forks=[phase],
         test_cases=cases,
     )
+
+
+def ssz_static_phase1_suite(preset: str) -> Suite:
+    return ssz_static_suite(preset, phase="phase1")
 
 
 # ---------------------------------------------------------------------------
@@ -311,4 +325,4 @@ def ssz_generic_suite(preset: str) -> Suite:
 def all_creators():
     return (operations_creators() + epoch_processing_creators()
             + sanity_creators() + [shuffling_suite] + bls_creators()
-            + [ssz_static_suite, ssz_generic_suite])
+            + [ssz_static_suite, ssz_static_phase1_suite, ssz_generic_suite])

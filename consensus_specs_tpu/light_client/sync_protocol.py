@@ -263,10 +263,9 @@ def prove_period_data(spec, state, slot: int, shard_id: int, later: bool,
     registry length (so the verifier can recompute list indices), and the
     seed inputs generate_seed reads — the active-index-root leaf doubles
     as the commitment the shipped active_indices expansion must hash to.
-    Pass a prebuilt SSZMerkleTree(state) via `tree` to amortize the full-
-    state hashing across the earlier/later pair (build_validator_memory's
-    shape) and across clients."""
-    from ..utils.ssz.impl import hash_tree_root
+    Pass a prebuilt SSZMerkleTree(state, spec.BeaconState) via `tree` to
+    amortize the full-state hashing across the earlier/later pair
+    (build_validator_memory's shape) and across clients."""
     from .multiproof import (LENGTH_FLAG, SSZMerkleTree,
                              generalized_index_for_path)
 
@@ -281,7 +280,8 @@ def prove_period_data(spec, state, slot: int, shard_id: int, later: bool,
     paths += _seed_input_paths(spec, period_start)
     indices = [generalized_index_for_path(state, typ, p) for p in paths]
     partial = tree.prove(indices)
-    assert partial.root == hash_tree_root(state, typ)
+    # the tree constructor already asserted nodes[1] == hash_tree_root(state)
+    assert tree.value is state and partial.root == tree.root
     active = [int(i) for i in
               spec.get_active_validator_indices(state, period_start)]
     return pd, PeriodDataProof(partial=partial, active_indices=active)

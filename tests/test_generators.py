@@ -113,3 +113,15 @@ def test_ssz_generic_uint_suite_diffs_against_main_stack():
                 v = int(c["value"])
                 assert v < 0 or v >= 2 ** bits
     assert n_valid >= 60 and n_invalid >= 36
+
+
+def test_ssz_static_phase1_covers_extended_containers():
+    suite = suites.ssz_static_phase1_suite("minimal")
+    names = {c["type_name"] for c in suite.test_cases}
+    # field-appended phase-0 types AND the new phase-1 families
+    for required in ("BeaconState", "Validator", "ShardBlock",
+                     "CustodyBitChallenge", "CustodyKeyReveal"):
+        assert required in names, required
+    assert suite.handler == "core_phase1" and suite.forks == ["phase1"]
+    for c in suite.test_cases[:10]:
+        assert c["serialized"].startswith("0x") and len(c["root"]) == 66

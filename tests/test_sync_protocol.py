@@ -191,12 +191,17 @@ def test_period_data_merkle_partial_roundtrip(spec, state):
     pd_bad2.seed = b"\x55" * 32
     assert not sp.verify_period_data(spec, root, pd_bad2, proof,
                                      slot=0, shard_id=2, later=True)
-    # forged committee span riding the honest proof (records/seed intact)
+    # forged committee span riding the honest proof (records/seed intact):
+    # an unconditional tamper so the rejection path always runs
     pd_bad3 = copy.deepcopy(pd)
-    pd_bad3.committee = sorted(pd_bad3.committee)
-    if pd_bad3.committee != pd.committee:
-        assert not sp.verify_period_data(spec, root, pd_bad3, proof,
-                                         slot=0, shard_id=2, later=True)
+    if len(pd_bad3.committee) > 1:
+        pd_bad3.committee = ([pd_bad3.committee[1], pd_bad3.committee[0]]
+                             + list(pd_bad3.committee[2:]))
+    else:
+        pd_bad3.committee = list(pd_bad3.committee) + [0]
+    assert pd_bad3.committee != list(pd.committee)
+    assert not sp.verify_period_data(spec, root, pd_bad3, proof,
+                                     slot=0, shard_id=2, later=True)
     # forged active-index expansion (wrong count)
     proof_bad = copy.deepcopy(proof)
     proof_bad.active_indices = proof.active_indices[:-1]
