@@ -440,7 +440,11 @@ def modular_squareroot(value: Fq2) -> Optional[Fq2]:
     return None
 
 
-def hash_to_g2(message_hash: bytes, domain: int) -> Tuple[Fq2, Fq2]:
+def hash_to_g2_candidate(message_hash: bytes, domain: int) -> Tuple[Fq2, Fq2]:
+    """The try-and-increment curve point BEFORE the cofactor multiply
+    (bls_signature.md:70-87). Split out so the expensive ~508-bit cofactor
+    multiplication can run batched on device (ops/bls_jax.hash_to_g2_batch)
+    while this data-dependent search stays host-side."""
     domain_bytes = int(domain).to_bytes(8, "big")
     x_re = int.from_bytes(hashlib.sha256(message_hash + domain_bytes + b"\x01").digest(), "big")
     x_im = int.from_bytes(hashlib.sha256(message_hash + domain_bytes + b"\x02").digest(), "big")
@@ -449,8 +453,12 @@ def hash_to_g2(message_hash: bytes, domain: int) -> Tuple[Fq2, Fq2]:
         y2 = x * x * x + G2_B
         y = modular_squareroot(y2)
         if y is not None:
-            return ec_mul((x, y), G2_COFACTOR)
+            return (x, y)
         x = x + FQ2_ONE
+
+
+def hash_to_g2(message_hash: bytes, domain: int) -> Tuple[Fq2, Fq2]:
+    return ec_mul(hash_to_g2_candidate(message_hash, domain), G2_COFACTOR)
 
 
 # ---------------------------------------------------------------------------

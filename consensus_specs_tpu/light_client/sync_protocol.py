@@ -279,9 +279,18 @@ def prove_period_data(spec, state, slot: int, shard_id: int, later: bool,
     paths += [["validator_registry", i] for i in sorted(pd.validators)]
     paths += _seed_input_paths(spec, period_start)
     indices = [generalized_index_for_path(state, typ, p) for p in paths]
+    # stale-tree guard without re-hashing the whole state: the prebuilt
+    # tree must still agree with the state's mutable scalars — the slot
+    # chunk and the registry length leaf pin the snapshot O(1) (a tree
+    # built before a slot advance or a deposit fails here)
+    assert tree.value is state and tree.typ is typ
+    slot_gidx = generalized_index_for_path(state, typ, ["slot"])
+    assert int.from_bytes(tree.nodes[slot_gidx][:8], "little") == int(state.slot)
+    len_gidx = generalized_index_for_path(state, typ,
+                                          ["validator_registry", LENGTH_FLAG])
+    assert int.from_bytes(tree.nodes[len_gidx][:8], "little") == \
+        len(state.validator_registry)
     partial = tree.prove(indices)
-    # the tree constructor already asserted nodes[1] == hash_tree_root(state)
-    assert tree.value is state and partial.root == tree.root
     active = [int(i) for i in
               spec.get_active_validator_indices(state, period_start)]
     return pd, PeriodDataProof(partial=partial, active_indices=active)

@@ -287,3 +287,14 @@ def test_aggregate_pubkeys_rejects_malformed_like_oracle(backends):
         for backend in (py, jx):
             with pytest.raises(AssertionError):
                 backend.aggregate_pubkeys(good + [bad])
+
+
+def test_hash_to_g2_batch_matches_oracle(backends):
+    """The batched device cofactor-multiply path must equal gt.hash_to_g2
+    per (message, domain) pair — mixed domains in one batch."""
+    from consensus_specs_tpu.ops.bls_jax import hash_to_g2_batch
+    reqs = [(bytes([m]) * 32, d) for m in (1, 2, 3) for d in (0, 7)]
+    got = hash_to_g2_batch(reqs)
+    want = [gt.hash_to_g2(mh, d) for mh, d in reqs]
+    assert got == want
+    assert hash_to_g2_batch([]) == []
