@@ -67,9 +67,56 @@ def ejection(spec, state):
     assert not spec.is_active_validator(subject, spec.get_current_epoch(state))
 
 
+def churn_limit_saturation(spec, state):
+    """More queued validators than the churn limit: exactly churn-many
+    dequeue per epoch, in activation-eligibility order with index ties
+    broken stably (0_beacon-chain.md:1493-1503)."""
+    churn = spec.get_churn_limit(state)
+    n_queued = churn + 2
+    queued = list(range(n_queued))
+    for i in queued:
+        v = state.validator_registry[i]
+        # eligible long ago (<= finalized), but never dequeued
+        v.activation_eligibility_epoch = 0
+        v.activation_epoch = spec.FAR_FUTURE_EPOCH
+
+    yield from _at_epoch_end_run(spec, state)
+
+    dequeued = [i for i in queued
+                if state.validator_registry[i].activation_epoch
+                != spec.FAR_FUTURE_EPOCH]
+    # stable sort on equal eligibility epochs -> lowest indices first
+    assert dequeued == queued[:churn]
+    assert len(dequeued) == churn < n_queued
+
+
+def eligibility_order_beats_index_order(spec, state):
+    """A later-index validator with an EARLIER eligibility epoch dequeues
+    ahead of an earlier-index one (sort key is eligibility, not index)."""
+    churn = spec.get_churn_limit(state)
+    n_queued = churn + 1
+    # index 0 gets the LATEST eligibility; the rest get progressively
+    # earlier ones, so index 0 must be the one left behind
+    for pos, i in enumerate(range(n_queued)):
+        v = state.validator_registry[i]
+        v.activation_eligibility_epoch = n_queued - pos
+        v.activation_epoch = spec.FAR_FUTURE_EPOCH
+    state.finalized_epoch = n_queued + 1   # all eligibilities finalized
+
+    yield from _at_epoch_end_run(spec, state)
+
+    assert state.validator_registry[0].activation_epoch == spec.FAR_FUTURE_EPOCH
+    for i in range(1, n_queued):
+        assert state.validator_registry[i].activation_epoch \
+            != spec.FAR_FUTURE_EPOCH, i
+
+
 CASES = [
     Case("activation", build=activation),
     Case("ejection", build=ejection),
+    Case("churn_limit_saturation", build=churn_limit_saturation),
+    Case("eligibility_order_beats_index_order",
+         build=eligibility_order_beats_index_order),
 ]
 
 

@@ -70,7 +70,7 @@ def test_run_generator_cli(tmp_path):
 
 def test_epoch_processing_suite(tmp_path):
     cases = cases_from_table(table("registry_updates"), "minimal", bls_default=False)
-    assert len(cases) == 2
+    assert len(cases) == 4
     for c in cases:
         assert c["post"] is not None
 
