@@ -271,7 +271,35 @@ def _progress(msg):
 _T_START = time.perf_counter()
 
 
+def _probe_backend(timeout_s: int = 180) -> None:
+    """Fail fast (exit 2) when the device backend is unreachable.
+
+    A wedged TPU relay hangs `jax.devices()` indefinitely inside
+    uninterruptible native code; probing in a subprocess with a timeout
+    converts a 40-minute silent hang into a quick, diagnosable failure the
+    retry loop can act on."""
+    import subprocess
+    import sys
+    code = "import jax; print(jax.devices()[0].platform)"
+    if os.environ.get("CSTPU_BENCH_CPU") == "1":
+        code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+                + code)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, timeout=timeout_s, text=True)
+        if proc.returncode == 0:
+            _progress(f"backend up: {proc.stdout.strip()}")
+            return
+        reason = (proc.stderr or "").strip().splitlines()[-1:] or ["unknown"]
+        _progress(f"backend init failed: {reason[0]}")
+    except subprocess.TimeoutExpired:
+        _progress(f"backend probe hung > {timeout_s}s (relay wedged?)")
+    sys.exit(2)
+
+
 def main():
+    _probe_backend()
     import jax
     # persistent compile cache: the traced Merkle/pairing programs take
     # ~1 min each to compile; cache hits make repeat bench runs fast

@@ -71,14 +71,16 @@ def churn_limit_saturation(spec, state):
     """More queued validators than the churn limit: exactly churn-many
     dequeue per epoch, in activation-eligibility order with index ties
     broken stably (0_beacon-chain.md:1493-1503)."""
-    churn = spec.get_churn_limit(state)
-    n_queued = churn + 2
+    n_queued = spec.get_churn_limit(state) + 2
     queued = list(range(n_queued))
     for i in queued:
         v = state.validator_registry[i]
-        # eligible long ago (<= finalized), but never dequeued
+        # long-eligible but never dequeued (activation still unset)
         v.activation_eligibility_epoch = 0
         v.activation_epoch = spec.FAR_FUTURE_EPOCH
+    # the spec recomputes the limit on the MUTATED state at dequeue time
+    churn = spec.get_churn_limit(state)
+    assert churn + 2 >= n_queued   # limit must not have grown past the queue
 
     yield from _at_epoch_end_run(spec, state)
 
@@ -101,7 +103,9 @@ def eligibility_order_beats_index_order(spec, state):
         v = state.validator_registry[i]
         v.activation_eligibility_epoch = n_queued - pos
         v.activation_epoch = spec.FAR_FUTURE_EPOCH
-    state.finalized_epoch = n_queued + 1   # all eligibilities finalized
+    # the outcome below assumes the dequeue-time limit leaves exactly one
+    # queued validator behind; pin it against the MUTATED state
+    assert spec.get_churn_limit(state) == n_queued - 1
 
     yield from _at_epoch_end_run(spec, state)
 
