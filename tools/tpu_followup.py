@@ -1,0 +1,113 @@
+"""One-shot TPU validation + profiling pass (run when the relay is up).
+
+Drives, on the real chip, everything added since the last on-TPU check:
+batched G1/G2 decompression, the fused decompress+aggregate paths, the
+batched hash_to_g2 cofactor multiply — each against the bignum oracle —
+then profiles the epoch-transition sub-stages with honest fences so the
+next optimization targets the real bottleneck.
+
+Usage: python tools/tpu_followup.py  (from the repo root)
+"""
+import sys
+import time
+
+import numpy as np
+
+
+def sync(x):
+    import jax
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return np.asarray(leaf.ravel()[0:1])
+
+
+def main():
+    import jax
+    print("devices:", jax.devices(), flush=True)
+
+    from consensus_specs_tpu.crypto import bls12_381 as gt
+    from consensus_specs_tpu.ops import decompress as D
+    from consensus_specs_tpu.ops.bls_jax import JaxBackend, hash_to_g2_batch
+
+    # 1) batched G1 decompress: 256 pubkeys, oracle spot-check
+    enc = [gt.privtopub(k) for k in range(1, 17)] * 16
+    data = np.stack([np.frombuffer(e, np.uint8) for e in enc])
+    t0 = time.time()
+    x, y, valid, inf = D.g1_decompress_batch(data)
+    print(f"g1 decompress 256 first: {time.time()-t0:.1f}s "
+          f"valid={bool(valid.all())}", flush=True)
+    t0 = time.time()
+    D.g1_decompress_batch(data)
+    print(f"g1 decompress 256 steady: {time.time()-t0:.2f}s", flush=True)
+    from consensus_specs_tpu.ops import fq as F
+    ox, oy = gt.decompress_g1(enc[3])
+    assert (F.from_mont(np.asarray(x)[3]), F.from_mont(np.asarray(y)[3])) \
+        == (ox, oy), "G1 decompress oracle mismatch on TPU"
+
+    # 2) fused aggregate (decompress + addition tree) parity
+    jx, py = JaxBackend(), gt.PythonBackend()
+    t0 = time.time()
+    agg = jx.aggregate_pubkeys(enc)
+    print(f"fused aggregate 256 first: {time.time()-t0:.1f}s", flush=True)
+    assert agg == py.aggregate_pubkeys(enc), "aggregate parity fail on TPU"
+    t0 = time.time()
+    jx.aggregate_pubkeys(enc)
+    print(f"fused aggregate 256 steady: {time.time()-t0:.2f}s", flush=True)
+
+    # 3) batched hash_to_g2 parity on chip
+    reqs = [(bytes([m]) * 32, 1) for m in range(8)]
+    t0 = time.time()
+    got = hash_to_g2_batch(reqs)
+    print(f"hash_to_g2 batch8 first: {time.time()-t0:.1f}s", flush=True)
+    assert got == [gt.hash_to_g2(mh, d) for mh, d in reqs], \
+        "hash_to_g2 batch parity fail on TPU"
+    t0 = time.time()
+    hash_to_g2_batch([(bytes([m]) * 32, 2) for m in range(8)])
+    print(f"hash_to_g2 batch8 steady: {time.time()-t0:.2f}s", flush=True)
+
+    # 4) unrolled == fori sha256 on chip
+    import jax.numpy as jnp
+    from consensus_specs_tpu.ops.sha256 import sha256_pairs
+    rng = np.random.default_rng(5)
+    words = jnp.asarray(rng.integers(0, 2 ** 32, (8192, 16), dtype=np.uint32))
+    a = np.asarray(sha256_pairs(words, unroll=True))
+    b = np.asarray(sha256_pairs(words, unroll=False))
+    assert (a == b).all(), "unrolled != fori on TPU"
+    print("sha256 unrolled == fori on chip", flush=True)
+
+    # 5) epoch sub-stage profile (which term dominates the ~400 ms?)
+    from consensus_specs_tpu.models import phase0
+    from consensus_specs_tpu.models.phase0.epoch_soa import (
+        EpochConfig, epoch_transition_device, synthetic_epoch_state)
+    spec = phase0.get_spec("mainnet")
+    cfg = EpochConfig.from_spec(spec)
+    V = 1_000_000
+    cols, scal, inp = synthetic_epoch_state(cfg, V, np.random.default_rng(42),
+                                            slashed_p=0.001, incl_delay_max=32,
+                                            random_slashed_balances=True)
+    sync(epoch_transition_device(cfg, cols, scal, inp))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sync(epoch_transition_device(cfg, cols, scal, inp))
+        ts.append(time.perf_counter() - t0)
+    print(f"epoch full: {min(ts)*1e3:.0f} ms", flush=True)
+
+    import jax
+    # isolate the activation-queue sort (suspected dominant term)
+    elig = np.asarray(cols.activation_eligibility_epoch, dtype=np.uint64) \
+        if hasattr(cols, "activation_eligibility_epoch") else None
+    if elig is not None:
+        key = jnp.asarray(elig)
+        f_sort = jax.jit(lambda k: jnp.argsort(k, stable=True))
+        sync(f_sort(key))
+        t0 = time.perf_counter()
+        sync(f_sort(key))
+        print(f"stable argsort alone: {(time.perf_counter()-t0)*1e3:.0f} ms",
+              flush=True)
+
+    print("ALL TPU FOLLOW-UP CHECKS PASSED", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
