@@ -1,0 +1,56 @@
+#!/bin/bash
+# Detached TPU-bench retry loop (VERDICT r3 directive #1).
+#
+# The axon TPU relay wedges for hours at a time (import jax hangs in
+# uninterruptible native code). This loop probes the backend in a
+# subprocess with a timeout, and whenever the relay is up it runs the
+# full benchmark (bench.py) plus the on-chip validation pass
+# (tools/tpu_followup.py — including the unrolled-SHA-256 check that
+# XLA:CPU cannot run), writes raw timestamped logs under bench_logs/,
+# and commits them. It exits once both passes succeed; until then it
+# keeps retrying forever, surviving the interactive session via setsid.
+#
+# Launch:  setsid nohup bash tools/bench_retry.sh >> bench_logs/retry_loop.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_logs
+
+commit_logs() {
+    local msg="$1"
+    for _ in 1 2 3 4 5; do
+        if git add bench_logs && git commit -q -m "$msg" -- bench_logs; then
+            return 0
+        fi
+        sleep 7   # index.lock contention with the interactive session
+    done
+    echo "WARN: could not commit bench_logs ($msg); left in working tree"
+    return 1
+}
+
+while true; do
+    ts=$(date -u +%Y%m%dT%H%M%SZ)
+    if timeout 180 python -c "import jax; print(jax.devices())" \
+            > bench_logs/probe_last.log 2>&1; then
+        echo "$ts probe OK: $(tail -1 bench_logs/probe_last.log)" \
+            >> bench_logs/probe_history.log
+        blog="bench_logs/bench_${ts}.log"
+        bjson="bench_logs/bench_${ts}.json"
+        PYTHONUNBUFFERED=1 timeout 3600 python bench.py > "$bjson" 2> "$blog"
+        rc=$?
+        echo "bench rc=$rc" >> "$blog"
+        flog="bench_logs/followup_${ts}.log"
+        PYTHONUNBUFFERED=1 timeout 3600 python tools/tpu_followup.py \
+            > "$flog" 2>&1
+        frc=$?
+        echo "followup rc=$frc" >> "$flog"
+        commit_logs "bench_logs: TPU run $ts (bench rc=$rc, followup rc=$frc)"
+        if [ "$rc" -eq 0 ] && [ "$frc" -eq 0 ]; then
+            echo "$ts" > bench_logs/SUCCESS
+            commit_logs "bench_logs: verified TPU bench + followup pass $ts"
+            exit 0
+        fi
+    else
+        echo "$ts probe FAILED (wedged relay?)" >> bench_logs/probe_history.log
+    fi
+    sleep 120
+done
