@@ -39,7 +39,45 @@ def hash(spec, data: bytes) -> bytes:  # noqa: A001 - spec name
     return cached
 
 
+_state_root_backend = None
+
+
+def set_state_root_backend(backend) -> None:
+    """Install a full-BeaconState Merkleizer: fn(state) -> bytes|None.
+
+    The per-slot `hash_tree_root(state)` is the reference's hottest loop
+    (0_beacon-chain.md:1232-1245); this hook routes it through the bulk
+    device Merkleizer (utils/ssz/bulk.py) the same way set_shuffle_backend
+    routes committee permutations. Returning None falls back to the
+    recursive oracle, so a backend can decline small states.
+    """
+    global _state_root_backend
+    _state_root_backend = backend
+
+
+def install_bulk_state_root(min_validators: int = 0) -> None:
+    """Route spec.hash_tree_root(state) through bulk.state_root_bulk.
+
+    Installed by production/bench entry points; tests install it explicitly
+    and differential-check against the recursive path. Below min_validators
+    the recursive oracle (with its hash cache) is kept.
+    """
+    from ...utils.ssz import bulk
+
+    def backend(state):
+        if len(state.validator_registry) < min_validators:
+            return None
+        return bulk.state_root_bulk(state)
+
+    set_state_root_backend(backend)
+
+
 def hash_tree_root(spec, obj: Any, typ: Any = None) -> bytes:
+    if (_state_root_backend is not None and typ is None
+            and obj.__class__ is getattr(spec, "BeaconState", None)):
+        root = _state_root_backend(obj)
+        if root is not None:
+            return root
     return ssz_hash_tree_root(obj, typ)
 
 
