@@ -22,11 +22,13 @@ from copy import deepcopy
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
-# exception classes that map to HTTP 400 at the API boundary: spec asserts
-# (AssertionError/IndexError) plus the malformed-container classes a
-# wrong-typed field raises inside the transition or SSZ machinery
-_INVALID = (AssertionError, IndexError, TypeError, ValueError,
-            AttributeError, KeyError)
+# exception classes that map to HTTP 400 at the API boundary: the classes
+# the spec's validity checks actually raise — assert statements
+# (AssertionError), out-of-range list access (IndexError), and the SSZ
+# machinery's rejection of ill-typed/ill-sized values (ValueError). Broader
+# classes (TypeError/AttributeError/KeyError) signal implementation bugs
+# and must propagate, not be masked as a client's 400.
+_INVALID = (AssertionError, IndexError, ValueError)
 
 VERSION = "consensus-specs-tpu/0.3"
 
@@ -170,11 +172,25 @@ class BeaconNodeAPI:
             bls.bls_active = old
         return block
 
+    def _decode_submission(self, obj, typ):
+        """Re-encode a submitted container through the SSZ wire codec —
+        the boundary a real node has (the body arrives as bytes). Garbage
+        a client could actually send (wrong-typed/oversized fields) fails
+        HERE as a 400; whatever decodes cleanly and still crashes the
+        transition with a non-spec exception class is OUR bug and
+        propagates."""
+        from ..utils.ssz.impl import deserialize, serialize
+        try:
+            return deserialize(serialize(obj, typ), typ)
+        except Exception:
+            raise ApiError(400, "malformed SSZ submission")
+
     def publish_block(self, block) -> None:
         """POST /validator/block: apply the signed block to the head state;
         an invalid block is a 400, never a crash (oapi.yaml:161-186)."""
         self._reject_if_syncing()
         spec = self.spec
+        block = self._decode_submission(block, spec.BeaconBlock)
         scratch = deepcopy(self.state)
         try:
             # a node accepting an external block verifies its claimed root
@@ -218,6 +234,7 @@ class BeaconNodeAPI:
         block includes it)."""
         self._reject_if_syncing()
         spec, state = self.spec, self.state
+        attestation = self._decode_submission(attestation, spec.Attestation)
         try:
             data_slot = spec.get_attestation_data_slot(state, attestation.data)
             assert data_slot <= state.slot

@@ -80,20 +80,26 @@ class GossipRouter:
         digest = sha256(topic_hash(topic) + payload)
         if digest in self._seen:
             return 0
-        reached = 0
-        for sub_id, handler in self._subs.get(topic_hash(topic), []):
-            if sub_id == node_id:
-                continue
-            try:
-                handler(topic, payload)
-                reached += 1
-            except Exception:
-                # a peer's handler failing is that peer's problem: delivery
-                # to the others proceeds and the failure is observable
-                self.handler_failures += 1
-        # mark seen only after the delivery sweep, so a message whose sweep
-        # raised out of the router (impossible above, but future-proof)
-        # would not be permanently blacklisted half-delivered
+        # mark seen BEFORE the delivery sweep: a handler that synchronously
+        # republishes the same message (the forwarding pattern) must hit the
+        # duplicate check, not re-enter a nested sweep. If the sweep itself
+        # escapes (impossible above, but future-proof), un-mark so a
+        # half-delivered message is not permanently blacklisted.
         self._seen.add(digest)
+        reached = 0
+        try:
+            for sub_id, handler in self._subs.get(topic_hash(topic), []):
+                if sub_id == node_id:
+                    continue
+                try:
+                    handler(topic, payload)
+                    reached += 1
+                except Exception:
+                    # a peer's handler failing is that peer's problem:
+                    # delivery to the others proceeds, observably counted
+                    self.handler_failures += 1
+        except BaseException:
+            self._seen.discard(digest)
+            raise
         self.delivered += reached
         return reached
