@@ -119,38 +119,10 @@ def bench_state_root_device() -> float:
 
 
 def _stage_attestation_pairs(n_groups, n_distinct=8):
-    """Host-stage n_groups spec-shaped pair triples (negG1/sig, pk0/H(m,0),
-    pk1/H(m,1)) with real signatures so every group verifies true.
-
-    Only `n_distinct` groups are staged with the (slow, pure-bignum) host
-    signer and then tiled: the device pairing work is value-independent, so
-    the measured batch time is identical while staging stays seconds. All
-    tiled groups still verify (they are real signatures)."""
-    from consensus_specs_tpu.crypto import bls12_381 as gt
-    from consensus_specs_tpu.ops import bls_jax as B
-    from consensus_specs_tpu.ops import fq as F
-
-    if n_groups > n_distinct:
-        g1d, g2d = _stage_attestation_pairs(n_distinct, n_distinct)
-        reps = (n_groups + n_distinct - 1) // n_distinct
-        return (np.tile(g1d, (reps, 1, 1, 1))[:n_groups],
-                np.tile(g2d, (reps, 1, 1, 1, 1))[:n_groups])
-
-    py = gt.PythonBackend()
-    g1 = np.zeros((n_groups, 3, 2, F.L), np.int64)
-    g2 = np.zeros((n_groups, 3, 2, 2, F.L), np.int64)
-    for g in range(n_groups):
-        msg = bytes([g % 256]) * 32
-        k0, k1 = 2 * g + 1, 2 * g + 2
-        agg = py.aggregate_signatures(
-            [py.sign(msg, k0, 1), py.sign(msg, k1, 1)])
-        pairs = [(gt.ec_neg(gt.G1_GEN), gt.decompress_g2(agg))]
-        h = gt.hash_to_g2(msg, 1)
-        for k in (k0, k1):
-            pairs.append((gt.decompress_g1(gt.privtopub(k)), h))
-        g1[g] = np.stack([B.g1_to_limbs(a) for a, _ in pairs])
-        g2[g] = np.stack([B.g2_to_limbs(b) for _, b in pairs])
-    return g1, g2
+    """See ops/bls_jax.stage_example_groups (shared with the mesh tests and
+    dryrun_multichip so all three present identical program shapes)."""
+    from consensus_specs_tpu.ops.bls_jax import stage_example_groups
+    return stage_example_groups(n_groups, n_distinct)
 
 
 def bench_bls_device():
@@ -250,6 +222,131 @@ def build_baseline_state(spec, V):
                 proposer_index=int(committee[0]),
             ))
     return state
+
+
+def build_config3_state_and_block(spec, V, n_attestations, n_keys=64):
+    """A state at an epoch boundary + a valid block carrying
+    `n_attestations` previous-epoch attestations with REAL aggregate
+    signatures over FULL committees (BASELINE config 3).
+
+    Staging trick (verifier work unchanged): validator i's keypair is
+    privkey (i % n_keys) + 1, so a committee's aggregate signature over the
+    shared message is ONE sign with the sum of member privkeys mod r. The
+    verifier still decompresses + aggregates every member pubkey and runs
+    the full grouped pairing — only the attester-side signing (not the
+    node's measured work) is shortcut."""
+    from consensus_specs_tpu.crypto import bls12_381 as gt
+    from consensus_specs_tpu.crypto.bls import get_backend
+    from consensus_specs_tpu.models.phase0.epoch_soa import (
+        _epoch_layout, columns_np_from_state)
+
+    backend = get_backend()
+    keypub = [gt.privtopub(k + 1) for k in range(n_keys)]
+    state = spec.BeaconState(
+        genesis_time=0, deposit_index=V,
+        latest_eth1_data=spec.Eth1Data(deposit_count=V))
+    state.balances = [spec.MAX_EFFECTIVE_BALANCE] * V
+    state.validator_registry = [
+        spec.Validator(
+            pubkey=keypub[i % n_keys],
+            withdrawal_credentials=b"\x00" * 32,
+            activation_eligibility_epoch=spec.GENESIS_EPOCH,
+            activation_epoch=spec.GENESIS_EPOCH,
+            exit_epoch=spec.FAR_FUTURE_EPOCH,
+            withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+            effective_balance=spec.MAX_EFFECTIVE_BALANCE,
+        )
+        for i in range(V)
+    ]
+    # First slot of epoch 2: every prev-epoch attestation slot s satisfies
+    # s + MIN_ATTESTATION_INCLUSION_DELAY <= slot <= s + SLOTS_PER_EPOCH
+    state.slot = 2 * spec.SLOTS_PER_EPOCH
+    prev = spec.get_previous_epoch(state)
+    lay = _epoch_layout(spec, state, columns_np_from_state(state), prev)
+    assert n_attestations <= lay.count, \
+        f"only {lay.count} committees at V={V}; raise V for {n_attestations}"
+    domain = spec.get_domain(state, spec.DOMAIN_ATTESTATION, prev)
+
+    attestations = []
+    for offset in range(n_attestations):
+        shard = (lay.start_shard + offset) % spec.SHARD_COUNT
+        committee = lay.shuffled[lay.bounds[offset]:lay.bounds[offset + 1]]
+        att_slot = (spec.get_epoch_start_slot(prev)
+                    + offset // (lay.count // spec.SLOTS_PER_EPOCH))
+        parent = state.previous_crosslinks[shard]
+        data = spec.AttestationData(
+            beacon_block_root=spec.get_block_root_at_slot(state, att_slot),
+            source_epoch=state.previous_justified_epoch,
+            source_root=state.previous_justified_root,
+            target_epoch=prev,
+            target_root=spec.get_block_root(state, prev),
+            crosslink=spec.Crosslink(
+                shard=shard,
+                parent_root=spec.hash_tree_root(parent),
+                end_epoch=min(prev, parent.end_epoch + spec.MAX_EPOCHS_PER_CROSSLINK),
+            ),
+        )
+        size = len(committee)
+        bitfield = bytearray(b"\xff" * (size // 8))
+        if size % 8:
+            bitfield.append((1 << (size % 8)) - 1)
+        msg = spec.hash_tree_root(
+            spec.AttestationDataAndCustodyBit(data=data, custody_bit=False))
+        k_agg = sum((int(i) % n_keys) + 1 for i in committee) % gt.r
+        attestations.append(spec.Attestation(
+            aggregation_bitfield=bytes(bitfield),
+            data=data,
+            custody_bitfield=bytes(len(bitfield)),
+            signature=backend.sign(msg, k_agg, domain),
+        ))
+
+    block = spec.BeaconBlock()
+    block.slot = state.slot
+    block.parent_root = spec.signing_root(state.latest_block_header)
+    block.body.eth1_data.deposit_count = state.deposit_index
+    block.body.attestations = attestations
+    proposer_key = (spec.get_beacon_proposer_index(state) % n_keys) + 1
+    epoch = spec.get_current_epoch(state)
+    block.body.randao_reveal = backend.sign(
+        spec.hash_tree_root(epoch), proposer_key,
+        spec.get_domain(state, spec.DOMAIN_RANDAO, epoch))
+    block.signature = backend.sign(
+        spec.signing_root(block), proposer_key,
+        spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER))
+    return state, block
+
+
+def bench_block_device() -> float:
+    """Config-3: seconds for ONE process_block carrying N_ATTESTATIONS real
+    attestations, every signature verified on device through the batched
+    pipeline (block.process_attestations_batched -> verify_indexed_batch).
+    Timed per state_transition semantics from a pre-built valid block;
+    compile warm-up runs the same shapes first on copies."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.models import phase0
+
+    old_active = bls.bls_active
+    bls.bls_active = True
+    bls.set_backend("jax")
+    try:
+        spec = phase0.get_spec("mainnet")
+        # smallest V whose prev epoch has >= N_ATTESTATIONS committees
+        # (count = SLOTS_PER_EPOCH * (V // SLOTS_PER_EPOCH // TARGET))
+        V = int(os.environ.get(
+            "CSTPU_BENCH_BLOCK_V",
+            spec.SLOTS_PER_EPOCH * spec.TARGET_COMMITTEE_SIZE
+            * max(1, -(-N_ATTESTATIONS // spec.SLOTS_PER_EPOCH))))
+        state, block = build_config3_state_and_block(spec, V, N_ATTESTATIONS)
+        warm_state = deepcopy(state)
+        spec.state_transition(warm_state, block)     # compile warm-up
+        fresh = deepcopy(state)
+        spec.clear_caches()
+        t0 = time.perf_counter()
+        spec.state_transition(fresh, block)
+        return time.perf_counter() - t0
+    finally:
+        bls.bls_active = old_active
+        bls.set_backend("python")
 
 
 def bench_state_to_state():
@@ -400,7 +497,10 @@ def main():
     t_root = bench_state_root_device()
     _progress(f"state root {t_root * 1e3:.1f} ms; BLS batch ({N_ATTESTATIONS} groups)")
     t_bls, t_py_verify = bench_bls_device()
-    _progress(f"BLS batch {t_bls * 1e3:.1f} ms; python baseline")
+    _progress(f"BLS batch {t_bls * 1e3:.1f} ms; config-3 block "
+              f"({N_ATTESTATIONS} real attestations, end-to-end)")
+    t_block = bench_block_device()
+    _progress(f"config-3 block {t_block * 1e3:.0f} ms; python baseline")
     py_epoch, py_root = bench_python_baseline()
     _progress("done")
 
@@ -419,11 +519,12 @@ def main():
         "unit": ("ms state-to-state+BLS (s2s %.0f ms = distill %.0f + epoch "
                  "%.0f + root %.0f, writeback %.0f ms excl.; kernel epoch "
                  "%.1f ms, kernel root %.1f ms; %d-agg-verify %.1f ms = %.0f "
-                 "aggverify/s/chip; python baseline %.0f ms scaled)"
+                 "aggverify/s/chip; config-3 block e2e %.0f ms; python "
+                 "baseline %.0f ms scaled)"
                  % (s2s_ms, tm["distill"] * 1e3, tm["device"] * 1e3,
                     tm["root"] * 1e3, tm["writeback"] * 1e3, t_epoch * 1e3,
                     t_root * 1e3, N_ATTESTATIONS, t_bls * 1e3,
-                    aggverify_per_s, py_total_ms)),
+                    aggverify_per_s, t_block * 1e3, py_total_ms)),
         "vs_baseline": round(py_total_ms / total_ms, 1),
     }))
 

@@ -56,17 +56,22 @@ def process_operations(spec, state, body) -> None:
     # No duplicate transfers
     assert len(body.transfers) == len(set(body.transfers))
 
-    for operations, max_operations, handler in (
-        (body.proposer_slashings, spec.MAX_PROPOSER_SLASHINGS, spec.process_proposer_slashing),
-        (body.attester_slashings, spec.MAX_ATTESTER_SLASHINGS, spec.process_attester_slashing),
-        (body.attestations, spec.MAX_ATTESTATIONS, spec.process_attestation),
-        (body.deposits, spec.MAX_DEPOSITS, spec.process_deposit),
-        (body.voluntary_exits, spec.MAX_VOLUNTARY_EXITS, spec.process_voluntary_exit),
-        (body.transfers, spec.MAX_TRANSFERS, spec.process_transfer),
+    # family = whole-list processor (the attestation family batches its
+    # signature checks into one device pipeline); handler = per-operation
+    for operations, max_operations, handler, family in (
+        (body.proposer_slashings, spec.MAX_PROPOSER_SLASHINGS, spec.process_proposer_slashing, None),
+        (body.attester_slashings, spec.MAX_ATTESTER_SLASHINGS, spec.process_attester_slashing, None),
+        (body.attestations, spec.MAX_ATTESTATIONS, spec.process_attestation, process_attestations_batched),
+        (body.deposits, spec.MAX_DEPOSITS, spec.process_deposit, None),
+        (body.voluntary_exits, spec.MAX_VOLUNTARY_EXITS, spec.process_voluntary_exit, None),
+        (body.transfers, spec.MAX_TRANSFERS, spec.process_transfer, None),
     ):
         assert len(operations) <= max_operations
-        for operation in operations:
-            handler(state, operation)
+        if family is not None:
+            family(spec, state, operations)
+        else:
+            for operation in operations:
+                handler(state, operation)
 
     # Later phases append operation families after all phase-0 ops (the
     # reference appends them via spec-doc ordering, 1_custody-game.md:330+)
@@ -75,6 +80,58 @@ def process_operations(spec, state, body) -> None:
         assert len(operations) <= max_operations
         for operation in operations:
             handler(state, operation)
+
+
+_batching_enabled = True
+
+
+def set_attestation_batching(enabled: bool) -> None:
+    """Test hook: force the sequential per-attestation verify path."""
+    global _batching_enabled
+    _batching_enabled = enabled
+
+
+def process_attestations_batched(spec, state, attestations) -> None:
+    """The block's attestation family with signature checks collapsed into
+    ONE grouped device pipeline (BASELINE config 3; 0_beacon-chain.md
+    :1625-1645, :1692-1727).
+
+    Each process_attestation runs all its host-side checks and state writes
+    in reference order, but validate_indexed_attestation defers its pairing
+    check into a sink (helpers.py); the collected block is then verified by
+    the backend's verify_indexed_batch — batched G1 aggregation, G2
+    decompression, hash_to_G2, and one grouped pairing program. A failed
+    verdict raises the same AssertionError the sequential path raises (the
+    reference discards half-mutated state on failure either way,
+    :1204-1219). Backends without batch support (the bignum oracle) and
+    crypto-off runs take the unchanged sequential path."""
+    batch = (getattr(spec.bls.get_backend(), "verify_indexed_batch", None)
+             if spec.bls.bls_active and _batching_enabled else None)
+    # Within this loop the only state mutations are PendingAttestation
+    # appends, so the slot's proposer index is invariant: pin it for the
+    # scope (each process_attestation consults it; up to 128 rejection-
+    # sampling recomputations collapse to one)
+    if len(attestations) > 1:
+        state._proposer_memo = (
+            (int(state.slot), len(state.validator_registry)),
+            spec.get_beacon_proposer_index(state))
+    try:
+        if batch is None or spec._att_verify_sink is not None:
+            for attestation in attestations:
+                spec.process_attestation(state, attestation)
+            return
+        sink = []
+        spec._att_verify_sink = sink
+        try:
+            for attestation in attestations:
+                spec.process_attestation(state, attestation)
+        finally:
+            spec._att_verify_sink = None
+        if sink:
+            assert all(batch(sink))
+    finally:
+        if len(attestations) > 1:
+            state._proposer_memo = None
 
 
 def process_proposer_slashing(spec, state, proposer_slashing) -> None:

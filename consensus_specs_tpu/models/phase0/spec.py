@@ -59,6 +59,12 @@ class Phase0Spec:
         self._insert_after_final_updates = []
         self._extra_block_operations = []   # (body_attr, max_count, handler)
 
+        # Deferred-verification sink: when process_operations batches a
+        # block's attestation signature checks, validate_indexed_attestation
+        # appends (pubkey_sets, message_hashes, signature, domain) here
+        # instead of verifying inline (block.process_attestations_batched)
+        self._att_verify_sink = None
+
         # Caches (reference epilogue: build_spec.py:78-105)
         self._hash_cache: Dict[bytes, bytes] = {}
         self._perm_cache: Dict = {}

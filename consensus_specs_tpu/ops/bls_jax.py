@@ -375,6 +375,33 @@ def _g1_decompress_aggregate_jit(x_raw, a_flag, is_inf):
 
 
 @jax.jit
+def _g1_decompress_aggregate_grouped_jit(x_raw, a_flag, is_inf):
+    """Segmented form of _g1_decompress_aggregate_jit for a block's worth
+    of committees: x_raw [G, C, L] (C pow2), flags [G, C] ->
+    (x_aff [G, L], y_aff [G, L], inf [G], all_valid [G]). All G*C
+    decompressions and every level of the G addition trees run in ONE
+    program — the config-3 aggregation shape (128 attestations' committees
+    at once, 0_beacon-chain.md:1022-1034)."""
+    x, y, valid = decomp._g1_decompress_traced(x_raw, a_flag)
+    all_valid = jnp.all(valid | is_inf, axis=1)
+    one = jnp.asarray(np.asarray(F.to_mont(1), np.int64))
+    zero = F.fq_zeros(())
+    jac_x = F.fq_select(is_inf, jnp.broadcast_to(zero, x.shape), x)
+    jac_y = F.fq_select(is_inf, jnp.broadcast_to(one, y.shape), y)
+    jac_z = F.fq_select(is_inf,
+                        jnp.broadcast_to(zero, x.shape),
+                        jnp.broadcast_to(one, x.shape))
+    cur = (jac_x, jac_y, jac_z)
+    while cur[0].shape[1] > 1:
+        a = tuple(c[:, 0::2] for c in cur)
+        b = tuple(c[:, 1::2] for c in cur)
+        cur = jac_add(G1_OPS, a, b)
+    single = tuple(c[:, 0] for c in cur)
+    x_aff, y_aff, inf = jac_to_affine(G1_OPS, single)
+    return x_aff, y_aff, inf, all_valid
+
+
+@jax.jit
 def _g2_decompress_aggregate_jit(x_raw, a_flag, is_inf):
     """Fused G2 decompress (Fq2 sqrt ladder) + addition tree; mirrors
     _g1_decompress_aggregate_jit's contract with [N, 2, L] coordinates."""
@@ -466,6 +493,69 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _grouped_pairing_dispatch(groups) -> dict:
+    """[(key, [(g1_limbs [2,L], g2_limbs [2,2,L])...])] -> {key: verdict}.
+
+    The one grouped-pairing dispatch shared by verify_multiple_batch and
+    verify_indexed_batch: bucket the groups by pair count, pad each bucket
+    to the next power of two with copies of its last member (log-many jit
+    shapes), run one grouped device program per bucket, scatter verdicts."""
+    verdicts: dict = {}
+    by_count: dict = {}
+    for key, pairs in groups:
+        by_count.setdefault(len(pairs), []).append((key, pairs))
+    for count, members in by_count.items():
+        g = _next_pow2(len(members))
+        g1 = np.zeros((g, count, 2, F.L), np.int64)
+        g2 = np.zeros((g, count, 2, 2, F.L), np.int64)
+        for k in range(g):
+            _, pairs = members[min(k, len(members) - 1)]
+            g1[k] = np.stack([a for a, _ in pairs])
+            g2[k] = np.stack([b for _, b in pairs])
+        ok = np.asarray(_grouped_pairing_check_jit(jnp.asarray(g1),
+                                                   jnp.asarray(g2)))
+        for k, (key, _) in enumerate(members):
+            verdicts[key] = bool(ok[k])
+    return verdicts
+
+
+def stage_example_groups(n_groups: int, n_distinct: int = 8
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-stage n_groups spec-shaped pair triples (negG1/sig, pk0/H(m,0),
+    pk1/H(m,1)) with real signatures so every group verifies true — the
+    grouped-pairing example batch shared by bench.py, the mesh tests, and
+    dryrun_multichip (one staging source keeps their shapes identical, so
+    the jit/persistent cache is shared too).
+
+    Only `n_distinct` groups are staged with the (slow, pure-bignum) host
+    signer and then tiled: the device pairing work is value-independent, so
+    measured batch time is unchanged while staging stays seconds. All tiled
+    groups still verify (they are real signatures)."""
+    from ..crypto import bls12_381 as gt
+
+    if n_groups > n_distinct:
+        g1d, g2d = stage_example_groups(n_distinct, n_distinct)
+        reps = (n_groups + n_distinct - 1) // n_distinct
+        return (np.tile(g1d, (reps, 1, 1, 1))[:n_groups],
+                np.tile(g2d, (reps, 1, 1, 1, 1))[:n_groups])
+
+    py = gt.PythonBackend()
+    g1 = np.zeros((n_groups, 3, 2, F.L), np.int64)
+    g2 = np.zeros((n_groups, 3, 2, 2, F.L), np.int64)
+    for g in range(n_groups):
+        msg = bytes([g % 256]) * 32
+        k0, k1 = 2 * g + 1, 2 * g + 2
+        agg = py.aggregate_signatures(
+            [py.sign(msg, k0, 1), py.sign(msg, k1, 1)])
+        pairs = [(gt.ec_neg(gt.G1_GEN), gt.decompress_g2(agg))]
+        h = gt.hash_to_g2(msg, 1)
+        for k in (k0, k1):
+            pairs.append((gt.decompress_g1(gt.privtopub(k)), h))
+        g1[g] = np.stack([g1_to_limbs(a) for a, _ in pairs])
+        g2[g] = np.stack([g2_to_limbs(b) for _, b in pairs])
+    return g1, g2
+
+
 def _decompress_and_aggregate(encodings, *, enc_len, label, parse,
                               coord_shape, agg_jit, compress, infinity):
     """Shared stage/pad/assert scaffold for the fused decompress+aggregate
@@ -549,27 +639,143 @@ class JaxBackend:
                   for item in items]
 
         results = [False] * len(items)
-        by_count: dict = {}
+        groups = []
         for i, pairs in enumerate(staged):
             if pairs is None:
                 continue
             if not pairs:
                 results[i] = True   # empty product
                 continue
-            by_count.setdefault(len(pairs), []).append(i)
+            groups.append((i, [(g1_to_limbs(a), g2_to_limbs(b))
+                               for a, b in pairs]))
+        for i, ok in _grouped_pairing_dispatch(groups).items():
+            results[i] = ok
+        return results
 
-        for count, members in by_count.items():
+    def verify_indexed_batch(self, items: Sequence[Tuple[Sequence[Sequence[bytes]],
+                                                         Sequence[bytes],
+                                                         bytes, int]]) -> List[bool]:
+        """A block's worth of indexed-attestation checks, every device stage
+        batched across the block (VERDICT r3 #4 / BASELINE config 3).
+
+        Items are (pubkey_sets, message_hashes, signature, domain) with one
+        pubkey set per message — the validate_indexed_attestation shape
+        (0_beacon-chain.md:1004-1035): set k aggregates to the pubkey paired
+        with message_hashes[k]. The pipeline is:
+          1. ONE grouped G1 decompress+aggregate program over every set of
+             every item (sets bucketed by padded committee size),
+          2. ONE batched G2 decompress over all signatures,
+          3. ONE batched hash_to_G2 cofactor multiply over distinct
+             (message, domain) pairs,
+          4. ONE grouped pairing program per surviving pair count.
+        Verdicts match [verify_multiple(aggregate(set_k)..., ...)] exactly:
+        malformed pubkey/signature encodings fail the item, empty sets and
+        infinity aggregates drop their pair, an empty product passes."""
+        n = len(items)
+        results = [None] * n   # None = still alive
+
+        # -- stage 1: grouped pubkey aggregation ---------------------------
+        sets = []   # (item, set_index, [pubkey bytes])
+        for i, (pubkey_sets, mhs, sig, domain) in enumerate(items):
+            if len(pubkey_sets) != len(mhs):
+                results[i] = False
+                continue
+            for s, pubkeys in enumerate(pubkey_sets):
+                if any(len(bytes(p)) != 48 for p in pubkeys):
+                    results[i] = False  # oracle: aggregate_pubkeys asserts
+                    break
+                if pubkeys:
+                    sets.append((i, s, [bytes(p) for p in pubkeys]))
+        agg = {}    # (item, set) -> (x_limbs, y_limbs) | None for infinity
+        by_c: dict = {}
+        for i, s, pubkeys in sets:
+            if results[i] is not None:
+                continue
+            by_c.setdefault(_next_pow2(len(pubkeys)), []).append((i, s, pubkeys))
+        for c, members in by_c.items():
             g = _next_pow2(len(members))
-            g1 = np.zeros((g, count, 2, F.L), np.int64)
-            g2 = np.zeros((g, count, 2, 2, F.L), np.int64)
-            for j in range(g):
-                pairs = staged[members[min(j, len(members) - 1)]]
-                g1[j] = np.stack([g1_to_limbs(a) for a, _ in pairs])
-                g2[j] = np.stack([g2_to_limbs(b) for _, b in pairs])
-            ok = np.asarray(_grouped_pairing_check_jit(jnp.asarray(g1),
-                                                       jnp.asarray(g2)))
-            for j, i in enumerate(members):
-                results[i] = bool(ok[j])
+            x_raw = np.zeros((g, c, F.L), np.int64)
+            a_flag = np.zeros((g, c), bool)
+            is_inf = np.ones((g, c), bool)
+            bad = np.zeros(g, bool)
+            for k in range(len(members)):
+                i, s, pubkeys = members[k]
+                data = np.stack([np.frombuffer(p, np.uint8) for p in pubkeys])
+                xr, af, inf, wf = decomp.parse_g1_bytes(data)
+                if not wf.all():
+                    bad[k] = True
+                    continue
+                m = len(pubkeys)
+                x_raw[k, :m], a_flag[k, :m], is_inf[k, :m] = xr, af, inf
+            x, y, inf, valid = _g1_decompress_aggregate_grouped_jit(
+                jnp.asarray(x_raw), jnp.asarray(a_flag), jnp.asarray(is_inf))
+            x, y = np.asarray(x), np.asarray(y)
+            inf, valid = np.asarray(inf), np.asarray(valid)
+            for k in range(len(members)):
+                i, s, _ = members[k]
+                if bad[k] or not valid[k]:
+                    results[i] = False
+                else:
+                    agg[(i, s)] = None if inf[k] else np.stack([x[k], y[k]])
+
+        # -- stage 2: batched signature decompression ----------------------
+        sig_pts = {}   # item -> [2, 2, L] limbs | None for infinity
+        sig_items = [i for i in range(n) if results[i] is None]
+        sig_ok = [i for i in sig_items if len(bytes(items[i][2])) == 96]
+        for i in set(sig_items) - set(sig_ok):
+            results[i] = False
+        if sig_ok:
+            data = np.stack([np.frombuffer(bytes(items[i][2]), np.uint8)
+                             for i in sig_ok])
+            x, y, valid, inf = decomp.g2_decompress_batch(data)
+            x, y = np.asarray(x), np.asarray(y)
+            for k, i in enumerate(sig_ok):
+                if not valid[k]:
+                    results[i] = False
+                else:
+                    sig_pts[i] = None if inf[k] else np.stack([x[k], y[k]])
+
+        # -- stage 3: batched message hashing ------------------------------
+        # Only messages whose pair survives to stage 4 (an empty pubkey set
+        # — every phase-0 custody_bit=True set — drops its pair, so its
+        # hash would be discarded). Below the threshold the per-message
+        # host bignum path wins, as in verify_multiple_batch above.
+        wanted = []
+        seen = set()
+        for i in range(n):
+            if results[i] is not None:
+                continue
+            _, mhs, _, domain = items[i]
+            for s, mh in enumerate(mhs):
+                key = (bytes(mh), int(domain))
+                if (i, s) in agg and key not in seen:
+                    seen.add(key)
+                    wanted.append(key)
+        if len(wanted) >= _HASH_BATCH_MIN:
+            hashed = dict(zip(wanted, hash_to_g2_batch(wanted)))
+        else:
+            hashed = {key: gt.hash_to_g2(*key) for key in wanted}
+
+        # -- stage 4: grouped pairing check --------------------------------
+        neg_g1 = g1_to_limbs(gt.ec_neg(gt.G1_GEN))
+        groups = []    # (item, [(g1 [2,L], g2 [2,2,L])])
+        for i in range(n):
+            if results[i] is not None:
+                continue
+            pubkey_sets, mhs, _, domain = items[i]
+            pairs = []
+            if sig_pts[i] is not None:
+                pairs.append((neg_g1, sig_pts[i]))
+            for s, mh in enumerate(mhs):
+                a = agg.get((i, s))   # absent = empty set = infinity
+                if a is not None:
+                    pairs.append((a, g2_to_limbs(hashed[(bytes(mh), int(domain))])))
+            if not pairs:
+                results[i] = True   # empty product
+            else:
+                groups.append((i, pairs))
+        for i, ok in _grouped_pairing_dispatch(groups).items():
+            results[i] = ok
         return results
 
     @staticmethod
