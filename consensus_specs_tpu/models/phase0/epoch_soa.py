@@ -119,10 +119,14 @@ def _total_balance(eff: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(jnp.sum(jnp.where(mask, eff, u64(0))), u64(1))
 
 
-@partial(jax.jit, static_argnums=(0,))
-def epoch_transition_device(cfg: EpochConfig, cols: ValidatorColumns,
-                            scal: EpochScalars, inp: EpochInputs):
-    """The whole numeric epoch transition, one traced program."""
+def _stage_a_traced(cfg: EpochConfig, cols: ValidatorColumns,
+                    scal: EpochScalars, inp: EpochInputs):
+    """Justification/finalization + rewards/penalties + registry updates —
+    everything BEFORE the phase-1 @process_reveal_deadlines insert point
+    (process_epoch order, :1251-1262 + 1_custody-game.md:668-696).
+    Returns (cols', scal', report) with balances post-rewards and
+    registry epochs post-updates; effective balances, slashed flags, the
+    slashed-balance table, and the start shard are untouched here."""
     V = cols.balance.shape[0]
     FAR = u64(cfg.FAR_FUTURE_EPOCH)
 
@@ -258,6 +262,52 @@ def epoch_transition_device(cfg: EpochConfig, cols: ValidatorColumns,
         dequeued & (cols.activation_epoch == FAR),
         current_epoch + u64(1) + u64(cfg.ACTIVATION_EXIT_DELAY), cols.activation_epoch)
 
+    mid_cols = ValidatorColumns(
+        activation_eligibility_epoch=elig,
+        activation_epoch=activation,
+        exit_epoch=exit_epoch,
+        withdrawable_epoch=withdrawable,
+        slashed=cols.slashed,
+        effective_balance=eff,
+        balance=balance,
+    )
+    mid_scal = EpochScalars(
+        slot=scal.slot,
+        previous_justified_epoch=prev_just,
+        current_justified_epoch=curr_just,
+        justification_bitfield=bitfield,
+        finalized_epoch=finalized,
+        latest_start_shard=scal.latest_start_shard,
+        latest_slashed_balances=scal.latest_slashed_balances,
+    )
+    report = EpochReport(
+        justified_prev_fired=just_prev & justification_active,
+        justified_curr_fired=just_curr & justification_active,
+        finalized_fired=fin_fired,
+        justification_active=justification_active,
+    )
+    return mid_cols, mid_scal, report
+
+
+def _stage_b_traced(cfg: EpochConfig, cols: ValidatorColumns,
+                    scal: EpochScalars):
+    """Slashings + the numeric final updates — everything AFTER the phase-1
+    reveal/challenge-deadline inserts (:1507-1564). Reads the columns as
+    they stand at its execution point (the inserts may have slashed
+    validators and grown the slashed-balance table), exactly like the
+    reference's sequential sub-transitions.
+
+    The active set and total balance it recomputes equal stage A's: rewards
+    change only balances, registry updates and phase-1 slashings move exit/
+    activation epochs strictly beyond the current epoch, and effective
+    balances change nowhere before the hysteresis below."""
+    eff = cols.effective_balance
+    balance = cols.balance
+    current_epoch = scal.slot // u64(cfg.SLOTS_PER_EPOCH)
+    active_curr = (cols.activation_epoch <= current_epoch) & (current_epoch < cols.exit_epoch)
+    total_balance = _total_balance(eff, active_curr)
+    active_count = jnp.sum(active_curr.astype(jnp.uint64))
+
     # -- Slashings (:1507-1524) ---------------------------------------------
     L = cfg.LATEST_SLASHED_EXIT_LENGTH
     lsb = scal.latest_slashed_balances
@@ -294,31 +344,26 @@ def epoch_transition_device(cfg: EpochConfig, cols: ValidatorColumns,
 
     lsb = lsb.at[next_epoch % u64(L)].set(lsb[current_epoch % u64(L)])
 
-    new_cols = ValidatorColumns(
-        activation_eligibility_epoch=elig,
-        activation_epoch=activation,
-        exit_epoch=exit_epoch,
-        withdrawable_epoch=withdrawable,
-        slashed=cols.slashed,
-        effective_balance=new_eff,
-        balance=balance,
-    )
-    new_scal = EpochScalars(
-        slot=scal.slot,
-        previous_justified_epoch=prev_just,
-        current_justified_epoch=curr_just,
-        justification_bitfield=bitfield,
-        finalized_epoch=finalized,
-        latest_start_shard=start_shard,
-        latest_slashed_balances=lsb,
-    )
-    report = EpochReport(
-        justified_prev_fired=just_prev & justification_active,
-        justified_curr_fired=just_curr & justification_active,
-        finalized_fired=fin_fired,
-        justification_active=justification_active,
-    )
+    new_cols = cols._replace(effective_balance=new_eff, balance=balance)
+    new_scal = scal._replace(latest_start_shard=start_shard,
+                             latest_slashed_balances=lsb)
+    return new_cols, new_scal
+
+
+@partial(jax.jit, static_argnums=(0,))
+def epoch_transition_device(cfg: EpochConfig, cols: ValidatorColumns,
+                            scal: EpochScalars, inp: EpochInputs):
+    """The whole numeric epoch transition, one traced program (the phase-0
+    fast path: both stages fuse — XLA sees exactly the pre-split op graph).
+    Phase 1 runs the two stages as separate programs with the insert hooks
+    between (process_epoch_soa)."""
+    mid_cols, mid_scal, report = _stage_a_traced(cfg, cols, scal, inp)
+    new_cols, new_scal = _stage_b_traced(cfg, mid_cols, mid_scal)
     return new_cols, new_scal, report
+
+
+_stage_a_jit = partial(jax.jit, static_argnums=(0,))(_stage_a_traced)
+_stage_b_jit = partial(jax.jit, static_argnums=(0,))(_stage_b_traced)
 
 
 # ===========================================================================
@@ -671,18 +716,15 @@ def process_epoch_soa(spec, state, timings: dict = None):
     process_epoch.
 
     Returns the post-transition device columns (still device-resident) so
-    production callers can chain the device state root without a re-upload —
-    or None when phase-1 insert hooks force the object-model fallback below
-    (`timings` is then left untouched).
+    production callers can chain the device state root without a re-upload.
     When `timings` is given, per-stage wall-clock seconds are recorded into
-    it ("distill", "device", "writeback") with honest output-fetch fences.
+    it ("distill", "device", "writeback") with honest output-fetch fences
+    (phase-1's staged path below leaves `timings` untouched).
     """
     if spec._insert_after_registry_updates or spec._insert_after_final_updates:
-        # Phase-1 hooks splice between sub-transitions that are fused in the
-        # device program; until the program is staged around them, fall back
-        # to the object-model path so hook ordering stays exact.
-        spec.process_epoch(state)
-        return None
+        # Phase-1 hooks splice between the two fused stages: run the device
+        # program staged around them, preserving exact insert ordering.
+        return process_epoch_soa_staged(spec, state)
 
     import time as _time
     t0 = _time.perf_counter()
@@ -715,7 +757,25 @@ def process_epoch_soa(spec, state, timings: dict = None):
 
     new_cols, new_scal, report = jax.device_get((dev_cols, dev_scal, dev_report))
 
-    # Justification scalars + roots
+    _apply_justification(spec, state, new_scal, report,
+                         previous_epoch, current_epoch)
+    _apply_validator_columns(state, new_cols)
+    state.latest_slashed_balances = [int(x) for x in np.asarray(new_scal.latest_slashed_balances)]
+    state.latest_start_shard = int(new_scal.latest_start_shard)
+
+    # Host-side final updates (:1526-1564), byte-rooted parts (shared helper)
+    spec.final_updates_byte_rooted(state)
+
+    if timings is not None:
+        timings["distill"] = t1 - t0
+        timings["device"] = t2 - t1
+        timings["writeback"] = _time.perf_counter() - t2
+    return dev_cols, dev_scal
+
+
+def _apply_justification(spec, state, new_scal, report,
+                         previous_epoch, current_epoch) -> None:
+    """Justification scalars + the root writes they gate (:1326-1373)."""
     if bool(report.justification_active):
         state.previous_justified_root = state.current_justified_root
         state.previous_justified_epoch = int(new_scal.previous_justified_epoch)
@@ -729,9 +789,11 @@ def process_epoch_soa(spec, state, timings: dict = None):
         if bool(report.finalized_fired):
             state.finalized_root = spec.get_block_root(state, state.finalized_epoch)
 
-    # Validator columns (.tolist() yields python ints ~10x faster than
-    # per-element int() casts at registry scale); `slashed` is excluded —
-    # the epoch transition never changes it
+
+def _apply_validator_columns(state, new_cols) -> None:
+    """Device columns -> object registry (.tolist() yields python ints ~10x
+    faster than per-element int() casts at registry scale); `slashed` is
+    excluded — the numeric epoch stages never change it."""
     arrs = {f: np.asarray(getattr(new_cols, f)).tolist()
             for f in ValidatorColumns._fields if f != "slashed"}
     for v, elig, act, exit_ep, wd, eff in zip(
@@ -744,16 +806,49 @@ def process_epoch_soa(spec, state, timings: dict = None):
         v.withdrawable_epoch = wd
         v.effective_balance = eff
     state.balances = arrs["balance"]
-    state.latest_slashed_balances = [int(x) for x in np.asarray(new_scal.latest_slashed_balances)]
-    state.latest_start_shard = int(new_scal.latest_start_shard)
 
-    # Host-side final updates (:1526-1564), byte-rooted parts (shared helper)
+
+def process_epoch_soa_staged(spec, state):
+    """The device epoch path for specs WITH phase-1 insert hooks
+    (VERDICT r3 #6): stage A (justification/rewards/registry) runs as one
+    device program, its results materialize to the object state, the
+    @process_reveal_deadlines/@process_challenge_deadlines hooks run on
+    that state (they slash validators and grow the slashed-balance table),
+    then stage B (slashings/final updates) re-distills the mutated columns
+    and runs as a second device program — the exact insert ordering of the
+    reference's process_epoch (1_custody-game.md:668-716). Differentially
+    tested against Phase1Spec.process_epoch in tests/test_phase1.py."""
+    cfg = EpochConfig.from_spec(spec)
+    np_cols = columns_np_from_state(state)
+    cols = columns_from_state(state, np_cols)
+    scal = scalars_from_state(state)
+    current_epoch = spec.get_current_epoch(state)
+    previous_epoch = spec.get_previous_epoch(state)
+
+    ctx = build_epoch_context(spec, state, np_cols)
+    process_crosslinks_vectorized(spec, state, ctx)
+    inp = build_epoch_inputs(spec, state, ctx)
+
+    mid = jax.device_get(_stage_a_jit(cfg, cols, scal, inp))
+    mid_cols, mid_scal, report = mid
+    _apply_justification(spec, state, mid_scal, report,
+                         previous_epoch, current_epoch)
+    _apply_validator_columns(state, mid_cols)
+
+    for hook in spec._insert_after_registry_updates:
+        hook(state)
+
+    cols2 = columns_from_state(state)
+    scal2 = scalars_from_state(state)
+    dev_cols, dev_scal = _stage_b_jit(cfg, cols2, scal2)
+    b_cols, b_scal = jax.device_get((dev_cols, dev_scal))
+    _apply_validator_columns(state, b_cols)
+    state.latest_slashed_balances = [int(x) for x in np.asarray(b_scal.latest_slashed_balances)]
+    state.latest_start_shard = int(b_scal.latest_start_shard)
+
     spec.final_updates_byte_rooted(state)
-
-    if timings is not None:
-        timings["distill"] = t1 - t0
-        timings["device"] = t2 - t1
-        timings["writeback"] = _time.perf_counter() - t2
+    for hook in spec._insert_after_final_updates:
+        hook(state)
     return dev_cols, dev_scal
 
 
