@@ -44,6 +44,23 @@ def shard_epoch_state(mesh: Mesh, cols: ValidatorColumns, scal: EpochScalars,
     return cols_s, scal_s, inp_s
 
 
+def shard_leading_axis(mesh: Mesh, tree):
+    """Shard every leaf's LEADING axis over the mesh's "v" axis.
+
+    The placement for the two other first-class parallel axes (SURVEY.md
+    §2c): the attestation/group axis of the grouped pairing check (each
+    group's pair product is independent — no cross-device traffic until
+    the final verdict gather) and the leaf axis of the bulk Merkleizer
+    (the reduction tree halves locally until the level fits one device,
+    then XLA inserts the cross-device combines). 0-d leaves replicate."""
+    shard = NamedSharding(mesh, P("v"))
+    repl = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x, shard if getattr(x, "ndim", 0) >= 1 else repl),
+        tree)
+
+
 def trees_bitwise_equal(a, b) -> bool:
     """Leafwise dtype/shape/value equality of two pytrees (host compare)."""
     leaves_a = jax.tree_util.tree_leaves(a)

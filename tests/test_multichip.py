@@ -52,6 +52,61 @@ def test_epoch_transition_sharded_equals_single(mesh, seed):
     assert trees_bitwise_equal(single, sharded)
 
 
+def test_grouped_pairing_sharded_equals_single(mesh):
+    """The attestation axis (SURVEY §2c axis #1): a batch of aggregate-
+    verify pair groups sharded over the mesh must give the single-device
+    verdicts bit-for-bit. Groups are independent pair products, so the
+    sharded program is embarrassingly parallel until the verdict gather."""
+    import jax.numpy as jnp
+    from consensus_specs_tpu.ops.bls_jax import (
+        _grouped_pairing_check_jit, stage_example_groups)
+    from consensus_specs_tpu.parallel import shard_leading_axis
+
+    g1, g2 = stage_example_groups(N_DEV)
+    single = np.asarray(_grouped_pairing_check_jit(jnp.asarray(g1),
+                                                   jnp.asarray(g2)))
+    assert single.all(), "staged groups must verify"
+    g1_s, g2_s = shard_leading_axis(mesh, (jnp.asarray(g1), jnp.asarray(g2)))
+    sharded = np.asarray(_grouped_pairing_check_jit(g1_s, g2_s))
+    np.testing.assert_array_equal(single, sharded)
+
+    # and a failing group must fail identically under sharding
+    g1_bad = g1.copy()
+    g1_bad[3, 1] = g1_bad[3, 2]   # swap in the wrong pubkey
+    single = np.asarray(_grouped_pairing_check_jit(jnp.asarray(g1_bad),
+                                                   jnp.asarray(g2)))
+    g1_s, g2_s = shard_leading_axis(mesh, (jnp.asarray(g1_bad),
+                                           jnp.asarray(g2)))
+    sharded = np.asarray(_grouped_pairing_check_jit(g1_s, g2_s))
+    assert not single[3] and not sharded[3]
+    np.testing.assert_array_equal(single, sharded)
+
+
+def test_bulk_merkleizer_sharded_equals_single(mesh):
+    """The Merkle leaf axis (SURVEY §2c axis #4): registry + balances roots
+    from columns sharded over the mesh == single-device == byte-identical
+    roots (the tree reduction crosses shards as the levels shrink)."""
+    import jax.numpy as jnp
+    from consensus_specs_tpu.parallel import shard_leading_axis
+    from consensus_specs_tpu.utils.ssz import bulk
+
+    rng = np.random.default_rng(11)
+    V = 256 * N_DEV
+    cols = (
+        rng.integers(0, 256, (V, 48), dtype=np.uint8),           # pubkeys
+        rng.integers(0, 256, (V, 32), dtype=np.uint8),           # wc
+        np.zeros(V, np.uint64), np.zeros(V, np.uint64),
+        np.zeros(V, np.uint64), np.zeros(V, np.uint64),
+        rng.random(V) < 0.01,                                    # slashed
+        np.full(V, 32_000_000_000, np.uint64),
+        rng.integers(31_000_000_000, 33_000_000_000, V).astype(np.uint64),
+    )
+    single = bulk.registry_and_balances_roots_device(*cols)
+    sharded_cols = shard_leading_axis(mesh, tuple(jnp.asarray(c) for c in cols))
+    sharded = bulk.registry_and_balances_roots_device(*sharded_cols)
+    assert single == sharded
+
+
 def test_sharded_output_stays_sharded(mesh):
     """With output shardings left to propagation, the result's [V] columns
     must come back sharded over the mesh — i.e. the partitioner kept the
