@@ -8,7 +8,7 @@ PYTHON ?= python
 VECTOR_DIR ?= out/vectors
 JUNIT ?= out/test-results.xml
 
-.PHONY: test citest lint vectors vectors-minimal bench multichip smoke clean
+.PHONY: test citest citest-mainnet lint vectors vectors-minimal bench multichip smoke clean
 
 # Full suite on the virtual CPU mesh (the conftest pins devices).
 test:
@@ -18,6 +18,14 @@ test:
 citest:
 	mkdir -p $(dir $(JUNIT))
 	$(PYTHON) -m pytest tests/ -x -q --junitxml=$(JUNIT)
+
+# Preset-divergence gate: the corpus subset where mainnet differs most from
+# minimal (committee counts 64 vs 8, 90 vs 10 shuffle rounds, 64-slot
+# epochs) runs under CSTPU_PRESET=mainnet (VERDICT r3 #7).
+citest-mainnet:
+	CSTPU_PRESET=mainnet CSTPU_ACCEL=1 $(PYTHON) -m pytest \
+		tests/test_spec_phase0.py -x -q \
+		-k "attestation or crosslinks or registry_updates or sanity_slots"
 
 # Syntax + style gate (see tools/lint.py; no third-party linters in image).
 lint:

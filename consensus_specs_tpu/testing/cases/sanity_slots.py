@@ -6,7 +6,6 @@ trigger process_epoch.
 """
 from __future__ import annotations
 
-from ...utils.ssz.impl import hash_tree_root
 from .. import factories as f
 from . import Case, install_pytests
 
@@ -19,7 +18,7 @@ def _slide(spec, state, slots):
 
 
 def one_slot(spec, state):
-    start_slot, start_root = state.slot, hash_tree_root(state)
+    start_slot, start_root = state.slot, spec.hash_tree_root(state)
     yield from _slide(spec, state, 1)
     assert state.slot == start_slot + 1
     assert f.saved_state_root(spec, state, start_slot) == start_root

@@ -128,7 +128,9 @@ def empty_block(spec, state, slot=None, *, signed: bool = False):
     block.body.eth1_data.deposit_count = state.deposit_index
     parent_header = deepcopy(state.latest_block_header)
     if parent_header.state_root == spec.ZERO_HASH:
-        parent_header.state_root = hash_tree_root(state)
+        # spec.hash_tree_root so an installed bulk state-root backend serves
+        # this (the recursive oracle is seconds per call at mainnet shapes)
+        parent_header.state_root = spec.hash_tree_root(state)
     block.parent_root = signing_root(parent_header)
     if signed:
         sign_proposal(spec, state, block)
@@ -175,7 +177,7 @@ def sign_proposal(spec, state, block, proposer_index=None) -> None:
 def apply_and_seal(spec, state, block) -> None:
     """state_transition, then seal the block with post-state root + sig."""
     spec.state_transition(state, block)
-    block.state_root = hash_tree_root(state)
+    block.state_root = spec.hash_tree_root(state)
     sign_proposal(spec, state, block)
 
 

@@ -32,6 +32,18 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import pytest  # noqa: E402
 
+# Accelerated-backend mode: route the spec's permutation and full-state-root
+# hooks through the batched/bulk kernels for the WHOLE corpus run. Used by
+# the mainnet CI job (make citest-mainnet), where 64-slot epochs of
+# recursive per-slot Merkleization are otherwise minutes per scenario —
+# and doubling as continuous differential coverage of the hooks (both are
+# bit-equality-tested against the recursive oracles in their own suites).
+if os.environ.get("CSTPU_ACCEL") == "1":
+    from consensus_specs_tpu.models.phase0.helpers import install_bulk_state_root
+    from consensus_specs_tpu.ops.shuffle import install_device_shuffler
+    install_bulk_state_root()
+    install_device_shuffler()
+
 
 def pytest_addoption(parser):
     parser.addoption(
