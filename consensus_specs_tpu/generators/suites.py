@@ -139,6 +139,8 @@ def _bls_priv_to_pub_cases():
 
 
 def _bls_msg_hash_cases():
+    """Uncompressed affine coordinates (reference
+    test_generators/bls/main.py:88-98: case01_message_hash_G2_uncompressed)."""
     out = []
     for msg in _BLS_MESSAGES:
         for dom in _BLS_DOMAINS:
@@ -146,6 +148,24 @@ def _bls_msg_hash_cases():
             out.append({
                 "input": {"message": "0x" + msg.hex(), "domain": dom},
                 "output": [[hex(x.c0), hex(x.c1)], [hex(y.c0), hex(y.c1)]],
+            })
+    return out
+
+
+def _bls_msg_hash_compressed_cases():
+    """Compressed (z1, z2) halves (reference test_generators/bls/main.py
+    :100-110 via :76-85: compress_G2 -> two 48-byte big-endian ints) —
+    cross-client consumers expect BOTH forms as separate handlers."""
+    out = []
+    for msg in _BLS_MESSAGES:
+        for dom in _BLS_DOMAINS:
+            z = curve.compress_g2(curve.hash_to_g2(msg, dom))
+            z1 = int.from_bytes(z[:48], "big")
+            z2 = int.from_bytes(z[48:], "big")
+            out.append({
+                "input": {"message": "0x" + msg.hex(), "domain": dom},
+                "output": ["0x" + z1.to_bytes(48, "big").hex(),
+                           "0x" + z2.to_bytes(48, "big").hex()],
             })
     return out
 
@@ -173,7 +193,8 @@ def bls_creators():
     handlers = {
         "sign_msg": _bls_sign_cases,
         "priv_to_pub": _bls_priv_to_pub_cases,
-        "msg_hash_g2": _bls_msg_hash_cases,
+        "msg_hash_g2_uncompressed": _bls_msg_hash_cases,
+        "msg_hash_g2_compressed": _bls_msg_hash_compressed_cases,
         "aggregate_sigs": _bls_aggregate_sig_cases,
         "aggregate_pubkeys": _bls_aggregate_pub_cases,
     }

@@ -26,3 +26,24 @@ def test_preset_immutable_and_replace():
 
 def test_preset_cached():
     assert load_preset("minimal") is load_preset("minimal")
+
+
+# ---------------------------------------------------------------------------
+# Fork timelines (reference configs/fork_timelines/*)
+# ---------------------------------------------------------------------------
+
+def test_fork_timelines_load_and_schedule():
+    from consensus_specs_tpu.utils.config import fork_at_epoch, load_fork_timeline
+    for name in ("mainnet", "testing"):
+        tl = load_fork_timeline(name)
+        assert tl["phase0"] == 0  # == GENESIS_EPOCH (GENESIS_SLOT normalized to 0)
+        assert fork_at_epoch(tl, 0) == "phase0"
+        assert fork_at_epoch(tl, 10 ** 6) in tl
+
+
+def test_fork_timeline_picks_latest_activated():
+    from consensus_specs_tpu.utils.config import fork_at_epoch
+    tl = {"phase0": 0, "phase1": 100}
+    assert fork_at_epoch(tl, 99) == "phase0"
+    assert fork_at_epoch(tl, 100) == "phase1"
+    assert fork_at_epoch(tl, 500) == "phase1"
