@@ -37,8 +37,11 @@ from .typing import (
     is_list_type, is_uint_type, is_vector_type, read_elem_type,
     uint_byte_size)
 
-# below this many 64-byte pair inputs, OpenSSL beats device dispatch
-_DEVICE_MIN_PAIRS = 2048
+# below this many 64-byte pair inputs, OpenSSL beats device dispatch —
+# set high because this host-orchestrated path pays a dispatch PER LEVEL
+# (over a tunneled relay that is milliseconds each); the chatty-free
+# alternative for production roots is the one-program device path below
+_DEVICE_MIN_PAIRS = 1 << 15
 
 
 # ---------------------------------------------------------------------------
@@ -63,10 +66,18 @@ def hash_pairs_array(pairs: np.ndarray) -> np.ndarray:
         digests = sha256_pairs(jnp.asarray(bytes_to_words(padded)))
         return words_to_bytes(np.asarray(digests))[:n]
     import hashlib
-    out = np.empty((n, 32), dtype=np.uint8)
-    for i in range(n):
-        out[i] = np.frombuffer(hashlib.sha256(pairs[i].tobytes()).digest(), np.uint8)
-    return out
+    sha = hashlib.sha256
+    # an all-identical level (a vector filled with one root, e.g. the
+    # genesis active-index roots) hashes once — O(n) check, no sort
+    if n >= 64 and (pairs == pairs[0]).all():
+        row = np.frombuffer(sha(pairs[0].tobytes()).digest(), np.uint8)
+        out = np.empty((n, 32), dtype=np.uint8)
+        out[:] = row
+        return out
+    buf = pairs.tobytes()
+    digests = b"".join(sha(buf[64 * i:64 * i + 64]).digest()
+                       for i in range(n))
+    return np.frombuffer(digests, np.uint8).reshape(n, 32)
 
 
 def _zero_chunk_rows(n: int, depth: int) -> np.ndarray:
@@ -76,7 +87,13 @@ def _zero_chunk_rows(n: int, depth: int) -> np.ndarray:
 
 def merkleize_chunk_array(chunks: np.ndarray) -> bytes:
     """Root over an [N, 32] uint8 chunk matrix (next-pow2 zero padding),
-    identical to merkle.merkleize_chunks on the equivalent byte list."""
+    identical to merkle.merkleize_chunks on the equivalent byte list.
+
+    Pairs of zero-subtree roots hash to the next zero-subtree root by
+    definition, so they are filled from the precomputed zerohash table
+    instead of hashed — the big state vectors (block/state/randao roots,
+    8,192 entries each) are mostly zero-suffixed, and a per-slot state root
+    must not pay full-vector hashing for them."""
     n = chunks.shape[0]
     if n == 0:
         return ZERO_BYTES32
@@ -85,8 +102,15 @@ def merkleize_chunk_array(chunks: np.ndarray) -> bytes:
     while level.shape[0] > 1:
         if level.shape[0] % 2 == 1:
             level = np.concatenate([level, _zero_chunk_rows(1, depth)])
-        level = hash_pairs_array(level.reshape(-1, 64))
+        pairs = level.reshape(-1, 64)
+        zero_pair = np.frombuffer(zerohashes[depth] * 2, dtype=np.uint8)
+        nonzero = ~np.all(pairs == zero_pair, axis=1)
         depth += 1
+        nxt = np.empty((pairs.shape[0], 32), dtype=np.uint8)
+        nxt[:] = np.frombuffer(zerohashes[depth], np.uint8)
+        if nonzero.any():
+            nxt[nonzero] = hash_pairs_array(pairs[nonzero])
+        level = nxt
     return level[0].tobytes()
 
 
