@@ -80,6 +80,29 @@ def hash_pairs_array(pairs: np.ndarray) -> np.ndarray:
     return np.frombuffer(digests, np.uint8).reshape(n, 32)
 
 
+# Content-keyed merkleization memo. sha256 trees are pure functions of
+# their input bytes, so (kind, raw bytes) -> result is sound. The per-slot
+# full-state root (the reference's hottest loop, 0_beacon-chain.md:1232-1245)
+# recomputes every field subtree while process_slot changed only a handful
+# of entries; the memo turns each unchanged subtree into one ~µs/32KB key
+# build plus a dict hit. Bounded by accumulated key bytes and cleared
+# wholesale when exceeded (the next state root repopulates the live set).
+_MEMO_MAX_BYTES = 96 * 1024 * 1024
+_MEMO_MAX_KEY = _MEMO_MAX_BYTES // 16   # one entry must never dominate the cap
+_MEMO_MIN_CHUNKS = 64         # below this, hashing is cheaper than keying
+_memo: dict = {}
+_memo_bytes = 0
+
+
+def _memo_put(kind, key: bytes, value) -> None:
+    global _memo_bytes
+    if _memo_bytes > _MEMO_MAX_BYTES:
+        _memo.clear()
+        _memo_bytes = 0
+    _memo[(kind, key)] = value
+    _memo_bytes += len(key) + len(value) + 64
+
+
 def _zero_chunk_rows(n: int, depth: int) -> np.ndarray:
     row = np.frombuffer(zerohashes[depth], dtype=np.uint8)
     return np.broadcast_to(row, (n, 32))
@@ -97,6 +120,12 @@ def merkleize_chunk_array(chunks: np.ndarray) -> bytes:
     n = chunks.shape[0]
     if n == 0:
         return ZERO_BYTES32
+    key = None
+    if _MEMO_MIN_CHUNKS <= n and n * 32 <= _MEMO_MAX_KEY:
+        key = chunks.tobytes()
+        hit = _memo.get(("mca", key))
+        if hit is not None:
+            return hit
     level = np.ascontiguousarray(chunks)
     depth = 0
     while level.shape[0] > 1:
@@ -111,7 +140,10 @@ def merkleize_chunk_array(chunks: np.ndarray) -> bytes:
         if nonzero.any():
             nxt[nonzero] = hash_pairs_array(pairs[nonzero])
         level = nxt
-    return level[0].tobytes()
+    root = level[0].tobytes()
+    if key is not None:
+        _memo_put("mca", key, root)
+    return root
 
 
 def subtree_roots_batch(leaves: np.ndarray) -> np.ndarray:
@@ -122,11 +154,20 @@ def subtree_roots_batch(leaves: np.ndarray) -> np.ndarray:
     batches even though each element's tree is tiny."""
     V, P, _ = leaves.shape
     assert P & (P - 1) == 0, "pad element chunk count to a power of two"
+    key = None
+    if _MEMO_MIN_CHUNKS <= V * P and V * P * 32 <= _MEMO_MAX_KEY:
+        key = leaves.tobytes()
+        hit = _memo.get((("srb", P), key))
+        if hit is not None:
+            return np.frombuffer(hit, np.uint8).reshape(V, 32).copy()
     level = leaves
     while level.shape[1] > 1:
         level = hash_pairs_array(
             level.reshape(-1, 64)).reshape(V, level.shape[1] // 2, 32)
-    return level[:, 0, :]
+    roots = level[:, 0, :]
+    if key is not None:
+        _memo_put(("srb", P), key, np.ascontiguousarray(roots).tobytes())
+    return roots
 
 
 # ---------------------------------------------------------------------------
