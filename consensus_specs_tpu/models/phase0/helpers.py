@@ -143,7 +143,12 @@ def is_slashable_validator(spec, validator, epoch: int) -> bool:
 
 
 def get_active_validator_indices(spec, state, epoch: int) -> List[int]:
-    return [i for i, v in enumerate(state.validator_registry) if spec.is_active_validator(v, epoch)]
+    """Indices active at `epoch` (reference 0_beacon-chain.md:678-685).
+    The predicate is inlined: the committee machinery calls this dozens
+    of times per transition, and a per-element is_active_validator frame
+    dominates the scan at registry scale."""
+    return [i for i, v in enumerate(state.validator_registry)
+            if v.activation_epoch <= epoch < v.exit_epoch]
 
 
 def increase_balance(spec, state, index: int, delta: int) -> None:
