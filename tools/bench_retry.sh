@@ -35,7 +35,11 @@ while true; do
             >> bench_logs/probe_history.log
         blog="bench_logs/bench_${ts}.log"
         bjson="bench_logs/bench_${ts}.json"
-        PYTHONUNBUFFERED=1 timeout 3600 python bench.py > "$bjson" 2> "$blog"
+        # 5400s: a fresh-cache first success needs epoch + root + two
+        # grouped-pairing shapes (~470s each) + the block pipeline compiled
+        # in one attempt; the persistent cache still carries partial
+        # progress into the next attempt if this one times out
+        PYTHONUNBUFFERED=1 timeout 5400 python bench.py > "$bjson" 2> "$blog"
         rc=$?
         echo "bench rc=$rc" >> "$blog"
         flog="bench_logs/followup_${ts}.log"
