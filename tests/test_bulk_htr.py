@@ -156,3 +156,53 @@ def test_device_resident_roots_empty_columns():
         np.zeros(0, bool), np.zeros(0, np.uint64), np.zeros(0, np.uint64))
     assert r1 == hash_tree_root([], SSZList[SPEC.Validator])
     assert r2 == hash_tree_root([], SSZList[uint64])
+
+
+# ---------------------------------------------------------------------------
+# Content-keyed merkleization memo
+# ---------------------------------------------------------------------------
+
+def test_merkleize_memo_differential_across_mutations():
+    """Memo hits must track content, not identity: mutate one chunk, re-root,
+    restore, re-root — every answer equals the oracle's, and the restored
+    matrix reproduces the original root from the cache."""
+    from consensus_specs_tpu.utils.merkle import merkleize_chunks
+    rng = np.random.default_rng(7)
+    chunks = rng.integers(0, 256, (256, 32), dtype=np.uint8)
+
+    def oracle(c):
+        return merkleize_chunks([c[i].tobytes() for i in range(c.shape[0])])
+
+    r0 = bulk.merkleize_chunk_array(chunks)
+    assert r0 == oracle(chunks)
+    assert bulk.merkleize_chunk_array(chunks) == r0       # cache hit
+    orig = chunks[11].copy()
+    chunks[11] ^= 0xFF
+    r1 = bulk.merkleize_chunk_array(chunks)
+    assert r1 != r0 and r1 == oracle(chunks)              # miss on new content
+    chunks[11] = orig
+    assert bulk.merkleize_chunk_array(chunks) == r0       # hit on old content
+
+
+def test_subtree_roots_memo_hit_is_writable_copy():
+    """A cached subtree_roots_batch result must come back as a fresh
+    writable array — a caller scribbling on it must not poison the cache."""
+    rng = np.random.default_rng(8)
+    leaves = rng.integers(0, 256, (64, 4, 32), dtype=np.uint8)
+    first = bulk.subtree_roots_batch(leaves).copy()
+    hit = bulk.subtree_roots_batch(leaves)
+    hit[:] = 0
+    again = bulk.subtree_roots_batch(leaves)
+    np.testing.assert_array_equal(again, first)
+
+
+def test_memo_size_gate_routes_large_inputs_around_cache():
+    """Matrices above the per-entry key cap bypass the memo (no insertion,
+    no thrash) and stay deterministic across calls."""
+    n = (bulk._MEMO_MAX_KEY // 32) + 1
+    chunks = np.zeros((n, 32), dtype=np.uint8)
+    chunks[0, 0] = 1
+    before = len(bulk._memo)
+    root = bulk.merkleize_chunk_array(chunks)
+    assert len(bulk._memo) == before          # nothing inserted
+    assert bulk.merkleize_chunk_array(chunks) == root
