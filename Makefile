@@ -25,7 +25,7 @@ citest:
 citest-mainnet:
 	CSTPU_PRESET=mainnet CSTPU_ACCEL=1 $(PYTHON) -m pytest \
 		tests/test_spec_phase0.py -x -q \
-		-k "attestation or crosslinks or registry_updates or sanity_slots"
+		-k "attestation or crosslinks or registry_updates or sanity_slots or finality"
 
 # Syntax + style gate (see tools/lint.py; no third-party linters in image).
 lint:
