@@ -26,6 +26,12 @@ TPU-first design notes:
   exponentiations instead of a 1270-bit one. The cube is harmless for
   product-is-one checks (gcd(3, r) = 1) and tests compare against the
   oracle's value cubed.
+- Kernel structure exploits the algebra: Miller-loop squarings use the
+  complex method (36 leaf products vs 54), line multiplies use dedicated
+  sparse tables (39 leaves), hard-part squarings use the Granger–Scott
+  cyclotomic form (30 leaves), and the sparse BLS parameter (Hamming
+  weight 6) unrolls each 64-bit exponentiation into runs of pure
+  squarings with six explicit multiplies (_pow_abs).
 - Verification is product-of-Miller-loops with ONE shared final
   exponentiation (specs/bls_signature.md:139-146), batched over the pair
   axis; aggregation is a log-depth tree of batched Jacobian adds.
@@ -184,14 +190,9 @@ _Z_BITS = np.frombuffer(bin(gt.BLS_X)[2:].encode(), dtype=np.uint8) - ord("0")
 _ZP1_BITS = np.frombuffer(bin(gt.BLS_X + 1)[2:].encode(), dtype=np.uint8) - ord("0")
 
 
-def _line_fq12(c_a, c_v, c_vw):
-    """Assemble l = c_a + c_v*v + c_vw*(v*w) as a full Fq12 element.
-
-    (w^3-scaled line for the divisive twist; see module docstring. A
-    dedicated sparse multiply is a later optimization — fq12_mul keeps the
-    first version simple and obviously correct.)"""
-    z = T.fq2_zeros(c_a.shape[:-2])
-    return T.fq12(T.fq6(c_a, c_v, z), T.fq6(z, c_vw, z))
+# Line elements l = c_a + c_v*v + c_vw*(v*w) multiply into f through the
+# dedicated sparse kernel T.fq12_mul_line (39 leaf products vs 54 for
+# assembling a full Fq12 element first).
 
 
 def miller_loop_batch(g1_aff, g2_aff):
@@ -223,7 +224,7 @@ def miller_loop_batch(g1_aff, g2_aff):
         c_a = T.fq2_sub(_muli(X3c, 3), _muli(T.fq2_mul(Y2, Z), 2))
         c_v = T.fq2_neg(T.fq2_scale(_muli(T.fq2_mul(X2, Z), 3), xp))
         c_vw = T.fq2_scale(_muli(T.fq2_mul(YZ, Z), 2), yp)
-        f = T.fq12_mul(T.fq12_sqr(f), _line_fq12(c_a, c_v, c_vw))
+        f = T.fq12_mul_line(T.fq12_sqr(f), c_a, c_v, c_vw)
         X4 = T.fq2_sqr(X2)
         Z2 = T.fq2_sqr(Z)
         Xn = _muli(T.fq2_mul(YZ, T.fq2_sub(_muli(X4, 9),
@@ -242,7 +243,7 @@ def miller_loop_batch(g1_aff, g2_aff):
         c_a = T.fq2_sub(T.fq2_mul(N, xq), T.fq2_mul(yq, D))
         c_v = T.fq2_neg(T.fq2_scale(N, xp))
         c_vw = T.fq2_scale(D, yp)
-        f = T.fq12_mul(f, _line_fq12(c_a, c_v, c_vw))
+        f = T.fq12_mul_line(f, c_a, c_v, c_vw)
         D2 = T.fq2_sqr(D)
         E = T.fq2_sub(T.fq2_sub(T.fq2_mul(T.fq2_sqr(N), Z), T.fq2_mul(D2, X)),
                       T.fq2_mul(T.fq2_mul(D2, xq), Z))
@@ -266,17 +267,31 @@ def miller_loop_batch(g1_aff, g2_aff):
 # Final exponentiation: f -> f^(3 * (q^12 - 1) / r)
 # ---------------------------------------------------------------------------
 
+def _cyclo_sqr_n(acc, k: int):
+    """k Granger–Scott squarings (k static)."""
+    if k <= 2:
+        for _ in range(k):
+            acc = T.fq12_cyclo_sqr(acc)
+        return acc
+    return jax.lax.fori_loop(0, k, lambda i, x: T.fq12_cyclo_sqr(x), acc)
+
+
 def _pow_abs(f, bits_np: np.ndarray):
-    """f^e for a static exponent bit array (MSB first), square-and-multiply
-    over a fori_loop. f must be free of the loop (closure constant)."""
-    bits = jnp.asarray(bits_np)
-
-    def body(i, acc):
-        acc = T.fq12_sqr(acc)
-        return T.fq12_select(bits[i] == 1, T.fq12_mul(acc, f), acc)
-
-    return jax.lax.fori_loop(0, int(bits_np.shape[0]), body,
-                             T.fq12_ones(f.shape[:-4]))
+    """f^e for a static exponent bit array (MSB first). f must be in the
+    cyclotomic subgroup (true for every call site: all exponentiations run
+    post-easy-part), so squarings use the Granger–Scott form (30 leaf
+    products). The BLS parameter is SPARSE (|z| = 0xD201000000010000 has
+    Hamming weight 6), so instead of a per-bit multiply+select (54 wasted
+    leaf products per zero bit) the exponent unrolls into runs of pure
+    squarings with one explicit multiply per set bit."""
+    positions = np.nonzero(bits_np)[0]
+    assert positions.size >= 1 and positions[0] == 0, "MSB must be set"
+    acc = f
+    prev = 0
+    for p in positions[1:]:
+        acc = T.fq12_mul(_cyclo_sqr_n(acc, int(p - prev)), f)
+        prev = int(p)
+    return _cyclo_sqr_n(acc, int(bits_np.shape[0]) - 1 - prev)
 
 
 def final_exponentiation_3x(f):
@@ -296,41 +311,19 @@ def final_exponentiation_3x(f):
         T.fq12_mul(T.fq12_conj(_pow_abs(T.fq12_conj(_pow_abs(b, _Z_BITS)), _Z_BITS)),
                    T.fq12_frobenius(b, 2)),
         T.fq12_conj(b))
-    f2_cubed = T.fq12_mul(T.fq12_mul(f2, f2), f2)
+    f2_cubed = T.fq12_mul(T.fq12_cyclo_sqr(f2), f2)   # f2 is cyclotomic
     return T.fq12_mul(c, f2_cubed)
 
 
-def pairing_product_is_one(g1_batch, g2_batch):
-    """prod_i e(P_i, Q_i) == 1 with one shared final exponentiation.
-    g1_batch [N, 2, L], g2_batch [N, 2, 2, L], N >= 1 static."""
-    fs = miller_loop_batch(g1_batch, g2_batch)       # [N, 2, 3, 2, L]
-    n = fs.shape[0]
-
-    def body(i, acc):
-        return T.fq12_mul(acc, fs[i])
-
-    f = jax.lax.fori_loop(0, n, body, T.fq12_ones(()))
-    res = final_exponentiation_3x(f)
-    return T.fq12_eq(res, T.fq12_ones(()))
+_miller_loop_batch_jit = jax.jit(miller_loop_batch)
 
 
-_pairing_check_jit = jax.jit(pairing_product_is_one)
-
-
-def grouped_pairing_check(g1, g2):
-    """[G] independent product-of-pairings checks in ONE device program.
-
-    g1 [G, P, 2, L], g2 [G, P, 2, 2, L]: group g passes iff
-    prod_p e(P_gp, Q_gp) == 1. The throughput shape for a block's
-    attestations (spec bls_verify_multiple per attestation,
-    /root/reference specs/bls_signature.md:139-146, called per op at
-    0_beacon-chain.md:1022-1034): all G*P Miller loops run as one batch,
-    the within-group product is a short fori over P, and the final
-    exponentiation runs batched over all G groups at once."""
-    G, P = g1.shape[0], g1.shape[1]
-    fs = miller_loop_batch(g1.reshape((G * P,) + g1.shape[2:]),
-                           g2.reshape((G * P,) + g2.shape[2:]))
-    fs = fs.reshape((G, P) + fs.shape[1:])
+@jax.jit
+def _group_product_is_one_jit(fs):
+    """fs [G, P, 2, 3, 2, L] Miller values -> [G] bool: within-group
+    product (short fori over P) + ONE final exponentiation batched over
+    all G groups."""
+    G, P = fs.shape[0], fs.shape[1]
 
     def body(p, acc):
         return T.fq12_mul(acc, fs[:, p])
@@ -340,7 +333,37 @@ def grouped_pairing_check(g1, g2):
     return T.fq12_eq(res, T.fq12_ones((G,)))
 
 
-_grouped_pairing_check_jit = jax.jit(grouped_pairing_check)
+def pairing_product_is_one(g1_batch, g2_batch):
+    """prod_i e(P_i, Q_i) == 1 with one shared final exponentiation.
+    g1_batch [N, 2, L], g2_batch [N, 2, 2, L], N >= 1 static.
+    Returns a [1] bool array (the N pairs form one group)."""
+    fs = _miller_loop_batch_jit(g1_batch, g2_batch)  # [N, 2, 3, 2, L]
+    return _group_product_is_one_jit(fs[None])
+
+
+def grouped_pairing_check(g1, g2):
+    """[G] independent product-of-pairings checks on device.
+
+    g1 [G, P, 2, L], g2 [G, P, 2, 2, L]: group g passes iff
+    prod_p e(P_gp, Q_gp) == 1. The throughput shape for a block's
+    attestations (spec bls_verify_multiple per attestation,
+    /root/reference specs/bls_signature.md:139-146, called per op at
+    0_beacon-chain.md:1022-1034): all G*P Miller loops run as one batch,
+    the within-group product is a short fori over P, and the final
+    exponentiation runs batched over all G groups at once.
+
+    Deliberately TWO separately-jitted programs (Miller batch; group
+    product + final exp) rather than one: each compiles — and lands in the
+    persistent compile cache — independently, so a flaky-relay window that
+    only fits one compile still makes durable progress, and the sharded
+    mesh path propagates through both. The [G*P] fq12 intermediate stays
+    device-resident between the calls."""
+    G, P = g1.shape[0], g1.shape[1]
+    fs = _miller_loop_batch_jit(g1.reshape((G * P,) + g1.shape[2:]),
+                                g2.reshape((G * P,) + g2.shape[2:]))
+    return _group_product_is_one_jit(fs.reshape((G, P) + fs.shape[1:]))
+
+
 
 
 # ---------------------------------------------------------------------------
@@ -512,7 +535,7 @@ def _grouped_pairing_dispatch(groups) -> dict:
             _, pairs = members[min(k, len(members) - 1)]
             g1[k] = np.stack([a for a, _ in pairs])
             g2[k] = np.stack([b for _, b in pairs])
-        ok = np.asarray(_grouped_pairing_check_jit(jnp.asarray(g1),
+        ok = np.asarray(grouped_pairing_check(jnp.asarray(g1),
                                                    jnp.asarray(g2)))
         for k, (key, _) in enumerate(members):
             verdicts[key] = bool(ok[k])
@@ -601,7 +624,7 @@ class JaxBackend:
             return True  # empty product
         g1 = np.stack([g1_to_limbs(a) for a, _ in pairs])
         g2 = np.stack([g2_to_limbs(b) for _, b in pairs])
-        return bool(np.asarray(_pairing_check_jit(g1, g2)))
+        return bool(np.asarray(pairing_product_is_one(g1, g2)))
 
     def verify(self, pubkey: bytes, message_hash: bytes, signature: bytes,
                domain: int) -> bool:

@@ -16,7 +16,9 @@ exactly ONE `fq_mul` instance. fq2_mul stacks its 3 Karatsuba leaves on a
 new axis; fq12_mul is a bilinear algorithm — its 54 Fq leaf products are
 one [..., 54, L] fq_mul between coefficient tables applied as trace-time
 unrolled adds (`_apply_int_matrix` — NEVER an einsum/dot_general: s64
-matmuls don't lower to the TPU; alpha/beta are the {0,1} pre-sum matrices,
+matmuls don't lower to the TPU; alpha/beta are small-integer pre-sum
+matrices (entries in {-2..2}: mul_xi/squaring pre-sums subtract and can
+fold a component twice),
 gamma the signed post-combination matrix), all derived at import time by
 running the tower's Karatsuba structure symbolically. Additions/subtractions are lazy single ops.
 """
@@ -152,8 +154,10 @@ def fq2_ones(shape=()):
 # The Karatsuba structure of Fq12 = ((Fq2)^3)^2 multiplication is executed
 # once at import over symbolic linear combinations; each base-field product
 # becomes a leaf. Result: A = alpha @ a_components, B = beta @ b_components,
-# P = A * B (leafwise), c = gamma @ P — with alpha/beta in {0,1} (pre-sums
-# are additions only) and gamma small signed integers.
+# P = A * B (leafwise), c = gamma @ P — alpha/beta entries are tiny signed
+# integers (|c| <= 2; mul_xi pre-sums subtract, squaring pre-sums can fold a
+# component twice) and gamma small signed integers; _check_budget bounds the
+# abs-weighted fan-in of all three.
 
 class _Lin:
     """Sparse integer linear combination over an index space."""
@@ -179,95 +183,166 @@ class _Lin:
         return _Lin({k: -v for k, v in self.d.items()})
 
 
-def _derive_fq12_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    leaves: List[Tuple[Dict[int, int], Dict[int, int]]] = []
+class _SymTower:
+    """The tower's Karatsuba multiplication structure executed symbolically:
+    every base-field product becomes a recorded leaf (or is dropped when one
+    operand is identically zero — that's how the sparse-line tables fall out
+    of the same code path). Pre-sum coefficients stay tiny (|c| <= 2) so the
+    leaf operands fit fq_mul's laziness budget (_check_budget)."""
 
-    def leaf(x: _Lin, y: _Lin) -> _Lin:
+    def __init__(self):
+        self.leaves: List[Tuple[Dict[int, int], Dict[int, int]]] = []
+
+    def leaf(self, x: _Lin, y: _Lin) -> _Lin:
+        if not x.d or not y.d:
+            return _Lin({})          # multiply by zero: no leaf recorded
         for c in list(x.d.values()) + list(y.d.values()):
-            assert c == 1, "pre-sums must be pure additions"
-        leaves.append((x.d, y.d))
-        return _Lin({len(leaves) - 1: 1})
+            # ±2 shows up in squaring pre-sums (the same component entering
+            # through both operands); the abs-weighted fan-in limit in
+            # _check_budget is the binding laziness constraint.
+            assert abs(c) <= 2, "pre-sum coefficient outside the budget"
+        self.leaves.append((x.d, y.d))
+        return _Lin({len(self.leaves) - 1: 1})
 
-    def mul2(a, b):  # Fq2 Karatsuba (mirrors fq2_mul)
+    def mul2(self, a, b):  # Fq2 Karatsuba (mirrors fq2_mul)
         a0, a1 = a
         b0, b1 = b
-        t0 = leaf(a0, b0)
-        t1 = leaf(a1, b1)
-        t2 = leaf(a0 + a1, b0 + b1)
+        t0 = self.leaf(a0, b0)
+        t1 = self.leaf(a1, b1)
+        t2 = self.leaf(a0 + a1, b0 + b1)
         return (t0 - t1, t2 - t0 - t1)
 
+    @staticmethod
     def mul_xi(c):  # (1+u) * c
         c0, c1 = c
         return (c0 - c1, c0 + c1)
 
+    @staticmethod
     def add2(a, b):
         return (a[0] + b[0], a[1] + b[1])
 
+    @staticmethod
     def sub2(a, b):
         return (a[0] - b[0], a[1] - b[1])
 
-    def mul6(a, b):  # Fq6 Karatsuba (mirrors gt.Fq6.__mul__)
+    def mul6(self, a, b):  # Fq6 Karatsuba (mirrors gt.Fq6.__mul__)
         a0, a1, a2 = a
         b0, b1, b2 = b
+        mul2, add2, sub2, mul_xi = self.mul2, self.add2, self.sub2, self.mul_xi
         t0, t1, t2 = mul2(a0, b0), mul2(a1, b1), mul2(a2, b2)
         c0 = add2(t0, mul_xi(sub2(mul2(add2(a1, a2), add2(b1, b2)), add2(t1, t2))))
         c1 = add2(sub2(mul2(add2(a0, a1), add2(b0, b1)), add2(t0, t1)), mul_xi(t2))
         c2 = add2(sub2(mul2(add2(a0, a2), add2(b0, b2)), add2(t0, t2)), t1)
         return (c0, c1, c2)
 
-    def add6(a, b):
-        return tuple(add2(x, y) for x, y in zip(a, b))
+    def add6(self, a, b):
+        return tuple(self.add2(x, y) for x, y in zip(a, b))
 
-    def sub6(a, b):
-        return tuple(sub2(x, y) for x, y in zip(a, b))
+    def sub6(self, a, b):
+        return tuple(self.sub2(x, y) for x, y in zip(a, b))
 
-    def mul6_by_v(a):
-        return (mul_xi(a[2]), a[0], a[1])
+    def mul6_by_v(self, a):
+        return (self.mul_xi(a[2]), a[0], a[1])
 
-    # symbolic inputs: component index = j*6 + i*2 + h for [w j][v i][fq2 h]
-    def sym(base):
+    @staticmethod
+    def sym(indices):
+        """Symbolic fq12 operand over the given 12 component indices
+        (None = structurally zero). Component order [w j][v i][fq2 h]."""
+        def lin(k):
+            return _Lin({}) if indices[k] is None else _Lin({indices[k]: 1})
         return tuple(
-            tuple((_Lin({base + j * 6 + i * 2 + 0: 1}),
-                   _Lin({base + j * 6 + i * 2 + 1: 1})) for i in range(3))
+            tuple((lin(j * 6 + i * 2 + 0), lin(j * 6 + i * 2 + 1))
+                  for i in range(3))
             for j in range(2))
 
-    a_sym = sym(0)
-    b_sym = sym(0)
-    a0, a1 = a_sym
-    b0, b1 = b_sym
-    t0 = mul6(a0, b0)
-    t1 = mul6(a1, b1)
-    mid = sub6(mul6(add6(a0, a1), add6(b0, b1)), add6(t0, t1))
-    c_lo = add6(t0, mul6_by_v(t1))
+    def tables(self, out12, n_a_cols: int, n_b_cols: int):
+        n = len(self.leaves)
+        alpha = np.zeros((n, n_a_cols), dtype=np.int64)
+        beta = np.zeros((n, n_b_cols), dtype=np.int64)
+        for k, (xa, xb) in enumerate(self.leaves):
+            for idx, c in xa.items():
+                alpha[k, idx] = c
+            for idx, c in xb.items():
+                beta[k, idx] = c
+        gamma = np.zeros((12, n), dtype=np.int64)
+        for j, lin in enumerate(out12):
+            for k, c in lin.d.items():
+                gamma[j, k] = c
+        return alpha, beta, gamma
+
+
+def _flatten12(c_lo, c_hi):
     out12 = []  # component order [j][i][h]
-    for six in (c_lo, mid):
+    for six in (c_lo, c_hi):
         for pair in six:
             out12.extend(pair)
+    return out12
 
-    n = len(leaves)
-    alpha = np.zeros((n, 12), dtype=np.int64)
-    beta = np.zeros((n, 12), dtype=np.int64)
-    for k, (xa, xb) in enumerate(leaves):
-        for idx, c in xa.items():
-            alpha[k, idx] = c
-        for idx, c in xb.items():
-            beta[k, idx] = c
-    gamma = np.zeros((12, n), dtype=np.int64)
-    for j, lin in enumerate(out12):
-        for k, c in lin.d.items():
-            gamma[j, k] = c
-    return alpha, beta, gamma
+
+def _derive_fq12_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Full product: 54 leaves."""
+    s = _SymTower()
+    a0, a1 = s.sym(list(range(12)))
+    b0, b1 = s.sym(list(range(12)))
+    t0 = s.mul6(a0, b0)
+    t1 = s.mul6(a1, b1)
+    mid = s.sub6(s.mul6(s.add6(a0, a1), s.add6(b0, b1)), s.add6(t0, t1))
+    c_lo = s.add6(t0, s.mul6_by_v(t1))
+    return s.tables(_flatten12(c_lo, mid), 12, 12)
+
+
+def _derive_fq12_sqr_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Complex-method squaring over Fq6 (w^2 = v): for a = c0 + c1 w,
+        t = c0*c1;  a^2 = ((c0+c1)(c0+v*c1) - t - v*t)  +  2t*w
+    — 2 Fq6 products = 36 leaves (vs 54 for mul(a, a)). Both leaf operands
+    draw from the SAME 12 components, so alpha and beta are both [36, 12]."""
+    s = _SymTower()
+    a0, a1 = s.sym(list(range(12)))
+    t = s.mul6(a0, a1)
+    big = s.mul6(s.add6(a0, a1), s.add6(a0, s.mul6_by_v(a1)))
+    c_lo = s.sub6(s.sub6(big, t), s.mul6_by_v(t))
+    c_hi = s.add6(t, t)
+    return s.tables(_flatten12(c_lo, c_hi), 12, 12)
+
+
+# Sparse line: l = c_a + c_v*v + c_vw*(v*w) — nonzero fq12 components
+# (j=0,i=0), (j=0,i=1), (j=1,i=1); b-column space is the 6 Fq coefficients
+# [c_a.0, c_a.1, c_v.0, c_v.1, c_vw.0, c_vw.1].
+_LINE_COLS = [0, 1, 2, 3, None, None, None, None, 4, 5, None, None]
+
+
+def _derive_fq12_line_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Full Karatsuba structure with the line's 6 structurally-zero
+    components dropped: 39 leaves (vs 54 for assembling the line into a full
+    fq12 and multiplying)."""
+    s = _SymTower()
+    a0, a1 = s.sym(list(range(12)))
+    b0, b1 = s.sym(_LINE_COLS)
+    t0 = s.mul6(a0, b0)
+    t1 = s.mul6(a1, b1)
+    mid = s.sub6(s.mul6(s.add6(a0, a1), s.add6(b0, b1)), s.add6(t0, t1))
+    c_lo = s.add6(t0, s.mul6_by_v(t1))
+    return s.tables(_flatten12(c_lo, mid), 12, 6)
+
+
+def _check_budget(alpha, beta, gamma, name: str):
+    # laziness check: pre-sum fan-in and post-combination growth must fit
+    # fq_mul's budget — limbs <= 64*2^29 = 2^35 (crushed by its defensive
+    # carry rounds) and values <= 64*2q < 2^388, keeping
+    # |v_a|*|v_b| < q*R = 2^787. A real raise: python -O must not strip it.
+    if (int(np.abs(gamma).sum(axis=1).max()) > 64
+            or int(np.abs(alpha).sum(axis=1).max()) > 8
+            or int(np.abs(beta).sum(axis=1).max()) > 8):
+        raise ValueError(f"{name} tables exceed the fq_mul laziness budget")
 
 
 _ALPHA, _BETA, _GAMMA = _derive_fq12_tables()
 _N_LEAVES = _ALPHA.shape[0]
-# laziness check: pre-sum fan-in and post-combination growth must fit
-# fq_mul's budget — limbs <= 64*2^29 = 2^35 (crushed by its defensive carry
-# rounds) and values <= 64*2q < 2^388, keeping |v_a|*|v_b| < q*R = 2^787.
-# A real raise (not assert): python -O must not strip this invariant.
-if (int(np.abs(_GAMMA).sum(axis=1).max()) > 64
-        or int(_ALPHA.sum(axis=1).max()) > 8 or int(_BETA.sum(axis=1).max()) > 8):
-    raise ValueError("fq12 bilinear tables exceed the fq_mul laziness budget")
+_check_budget(_ALPHA, _BETA, _GAMMA, "fq12_mul")
+_SQR_ALPHA, _SQR_BETA, _SQR_GAMMA = _derive_fq12_sqr_tables()
+_check_budget(_SQR_ALPHA, _SQR_BETA, _SQR_GAMMA, "fq12_sqr")
+_LINE_ALPHA, _LINE_BETA, _LINE_GAMMA = _derive_fq12_line_tables()
+_check_budget(_LINE_ALPHA, _LINE_BETA, _LINE_GAMMA, "fq12_mul_line")
 
 
 # ---------------------------------------------------------------------------
@@ -340,10 +415,6 @@ def fq6_zeros(shape=()):
     return jnp.zeros(tuple(shape) + (3, 2, F.L), dtype=jnp.int64)
 
 
-def fq6_select(cond, a, b):
-    return jnp.where(cond[..., None, None, None], a, b)
-
-
 # ---------------------------------------------------------------------------
 # Fq12  [..., 2, 3, 2, L]
 # ---------------------------------------------------------------------------
@@ -398,7 +469,87 @@ def fq12_mul(a, b):
 
 
 def fq12_sqr(a):
-    return fq12_mul(a, a)
+    """Complex-method squaring: ONE fq_mul of 36 leaves (vs 54 for mul)."""
+    batch = a.shape[:-4]
+    av = a.reshape(batch + (12, F.L))
+    A = _apply_int_matrix(_SQR_ALPHA, av)
+    Bv = _apply_int_matrix(_SQR_BETA, av)
+    P = F.fq_mul(A, Bv)                                   # [..., 36, L]
+    cv = _apply_int_matrix(_SQR_GAMMA, P)
+    return cv.reshape(batch + (2, 3, 2, F.L))
+
+
+def fq12_mul_line(f, c_a, c_v, c_vw):
+    """f * (c_a + c_v*v + c_vw*(v*w)) — the Miller-loop line multiply.
+
+    The line's six structurally-zero components are dropped at
+    table-derivation time: ONE fq_mul of 39 leaves (vs 54 for assembling
+    the line into a full fq12 element first). c_* are Fq2 [..., 2, L]."""
+    batch = f.shape[:-4]
+    fv = f.reshape(batch + (12, F.L))
+    bv = jnp.concatenate([c_a, c_v, c_vw], axis=-2)       # [..., 6, L]
+    A = _apply_int_matrix(_LINE_ALPHA, fv)
+    Bv = _apply_int_matrix(_LINE_BETA, bv)
+    P = F.fq_mul(A, Bv)                                   # [..., 39, L]
+    cv = _apply_int_matrix(_LINE_GAMMA, P)
+    return cv.reshape(batch + (2, 3, 2, F.L))
+
+
+def fq12_cyclo_sqr(a):
+    """Granger–Scott squaring in the cyclotomic subgroup G_Φ6(q^2):
+    30 leaf products across two fq_mul calls (vs 54 general / 36
+    complex-method).
+
+    View Fq12 = Fq4[y]/(y^3 - s), Fq4 = Fq2[s]/(s^2 - ξ) with y = w,
+    s = w^3; component z_e (coefficient of w^e) is stored at
+    [j=e%2, i=e//2]. For f = A + B y + C y^2 in the cyclotomic subgroup
+    (true post-easy-part in the final exponentiation):
+
+        f^2 = (3A² - 2Ā) + (3sC² + 2B̄) y + (3B² - 2C̄) y²
+
+    with Ā the Fq4 conjugate (s -> -s). Wiring validated against the
+    bignum oracle in tests/test_fq.py. Each Fq4 square (x0 + x1 s)² =
+    (m1 - m2 - ξm2) + 2m2 s with m1 = (x0+x1)(x0+ξx1), m2 = x0·x1 —
+    all six Fq2 products run as one stacked fq2_mul.
+
+    The ±2·conj terms pass input components straight to the output with no
+    intervening Montgomery reduction, so chained squarings (runs of up to
+    47 between the sparse BLS parameter's set bits) would grow VALUES ~2x
+    per step past fq_mul's |v_a|*|v_b| < q*R budget. One stacked
+    multiply-by-one Montgomery-reduces all twelve Fq components first
+    (value back into (-2q, 2q), limbs normalized): 12 extra leaves, 30
+    total."""
+    zs = F.fq_mul(a.reshape(a.shape[:-4] + (12, F.L)),
+                  F.fq_ones(())).reshape(a.shape)
+    z = [zs[..., e % 2, e // 2, :, :] for e in range(6)]
+    pairs = [(z[0], z[3]), (z[1], z[4]), (z[2], z[5])]    # A, B, C
+    lhs = jnp.stack([x0 + x1 for x0, x1 in pairs]
+                    + [x0 for x0, _ in pairs], axis=-3)
+    rhs = jnp.stack([x0 + fq2_mul_xi(x1) for x0, x1 in pairs]
+                    + [x1 for _, x1 in pairs], axis=-3)
+    P = fq2_mul(lhs, rhs)                                 # [..., 6, 2, L]
+    sq = []                                               # A², B², C² in Fq4
+    for k in range(3):
+        m1, m2 = P[..., k, :, :], P[..., 3 + k, :, :]
+        sq.append((m1 - m2 - fq2_mul_xi(m2), m2 + m2))
+    A2, B2, C2 = sq
+
+    def x3(t):
+        return t + t + t
+
+    def x2(t):
+        return t + t
+
+    out = [None] * 6
+    out[0] = x3(A2[0]) - x2(z[0])                         # A' = 3A² - 2Ā
+    out[3] = x3(A2[1]) + x2(z[3])
+    out[1] = x3(fq2_mul_xi(C2[1])) + x2(z[1])             # B' = 3sC² + 2B̄
+    out[4] = x3(C2[0]) - x2(z[4])
+    out[2] = x3(B2[0]) - x2(z[2])                         # C' = 3B² - 2C̄
+    out[5] = x3(B2[1]) + x2(z[5])
+    rows = [jnp.stack([out[2 * i + j] for i in range(3)], axis=-3)
+            for j in range(2)]
+    return jnp.stack(rows, axis=-4)
 
 
 def fq12_conj(a):
@@ -410,10 +561,6 @@ def fq12_inv(a):
     denom = fq6_sub(fq6_mul(a0, a0), fq6_mul_by_v(fq6_mul(a1, a1)))
     inv_d = fq6_inv(denom)
     return fq12(fq6_mul(a0, inv_d), fq6_neg(fq6_mul(a1, inv_d)))
-
-
-def fq12_select(cond, a, b):
-    return jnp.where(cond[..., None, None, None, None], a, b)
 
 
 def fq12_eq(a, b):

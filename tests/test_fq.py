@@ -221,6 +221,45 @@ def test_fq_sqr_scale_and_tower_sqr():
     assert fq12_out(T.fq12_sqr(fq12_batch(a12))) == [x.square() for x in a12]
 
 
+def test_fq12_mul_line():
+    """Sparse line multiply == full product with the assembled line element
+    l = c_a + c_v*v + c_vw*(v*w) (the Miller-loop shape, bls_jax)."""
+    zero2 = gt.Fq2(0, 0)
+    f_vals = [rand_fq12() for _ in range(3)]
+    c_a = [rand_fq2() for _ in range(3)]
+    c_v = [rand_fq2() for _ in range(3)]
+    c_vw = [rand_fq2() for _ in range(3)]
+    want = [
+        f * gt.Fq12(gt.Fq6(a, v, zero2), gt.Fq6(zero2, vw, zero2))
+        for f, a, v, vw in zip(f_vals, c_a, c_v, c_vw)
+    ]
+    out = T.fq12_mul_line(fq12_batch(f_vals), fq2_batch(c_a),
+                          fq2_batch(c_v), fq2_batch(c_vw))
+    assert fq12_out(out) == want
+
+
+def test_fq12_cyclo_sqr():
+    """Granger–Scott squaring == generic squaring on cyclotomic-subgroup
+    elements (staged via the easy part f^((q^6-1)(q^2+1)) on the oracle) —
+    the final-exponentiation _pow_abs precondition in bls_jax.
+
+    The 50-step chain is the regression for the value-growth bug: the
+    ±2·conj passthrough must Montgomery-reduce its inputs or chained
+    squarings (the BLS parameter has zero-runs up to 47) overflow the
+    fq_mul value budget."""
+    gs = []
+    for _ in range(2):
+        f = rand_fq12()
+        easy = f.conj() * f.inv()
+        gs.append((easy ** (gt.q ** 2)) * easy)
+    assert fq12_out(T.fq12_cyclo_sqr(fq12_batch(gs))) == [g * g for g in gs]
+
+    chained = fq12_batch(gs[:1])
+    for _ in range(50):
+        chained = T.fq12_cyclo_sqr(chained)
+    assert fq12_out(chained) == [gs[0] ** (2 ** 50)]
+
+
 def test_tower_eq_on_lazy_reps():
     """fq2/fq12 equality must see through non-canonical representations —
     this is the final pairing verdict path (bls_jax.pairing_product_is_one)."""

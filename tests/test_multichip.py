@@ -59,25 +59,25 @@ def test_grouped_pairing_sharded_equals_single(mesh):
     sharded program is embarrassingly parallel until the verdict gather."""
     import jax.numpy as jnp
     from consensus_specs_tpu.ops.bls_jax import (
-        _grouped_pairing_check_jit, stage_example_groups)
+        grouped_pairing_check, stage_example_groups)
     from consensus_specs_tpu.parallel import shard_leading_axis
 
     g1, g2 = stage_example_groups(N_DEV)
-    single = np.asarray(_grouped_pairing_check_jit(jnp.asarray(g1),
+    single = np.asarray(grouped_pairing_check(jnp.asarray(g1),
                                                    jnp.asarray(g2)))
     assert single.all(), "staged groups must verify"
     g1_s, g2_s = shard_leading_axis(mesh, (jnp.asarray(g1), jnp.asarray(g2)))
-    sharded = np.asarray(_grouped_pairing_check_jit(g1_s, g2_s))
+    sharded = np.asarray(grouped_pairing_check(g1_s, g2_s))
     np.testing.assert_array_equal(single, sharded)
 
     # and a failing group must fail identically under sharding
     g1_bad = g1.copy()
     g1_bad[3, 1] = g1_bad[3, 2]   # swap in the wrong pubkey
-    single = np.asarray(_grouped_pairing_check_jit(jnp.asarray(g1_bad),
+    single = np.asarray(grouped_pairing_check(jnp.asarray(g1_bad),
                                                    jnp.asarray(g2)))
     g1_s, g2_s = shard_leading_axis(mesh, (jnp.asarray(g1_bad),
                                            jnp.asarray(g2)))
-    sharded = np.asarray(_grouped_pairing_check_jit(g1_s, g2_s))
+    sharded = np.asarray(grouped_pairing_check(g1_s, g2_s))
     assert not single[3] and not sharded[3]
     np.testing.assert_array_equal(single, sharded)
 
