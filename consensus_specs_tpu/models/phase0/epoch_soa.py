@@ -447,6 +447,23 @@ class EpochContext(NamedTuple):
     curr_atts: list
     prev_parts: list         # [len(prev_atts)] np.ndarray participant indices
     curr_parts: list
+    cl_roots: dict           # content tuple -> hash_tree_root(Crosslink)
+
+
+def _crosslink_root(spec, ctx: "EpochContext", c) -> bytes:
+    """hash_tree_root(Crosslink) through a content-keyed cache.
+
+    _crosslink_winners runs three times per transition (two epochs in
+    process_crosslinks + the deltas pass re-selecting against the updated
+    records, mirroring process_epoch's ordering :1251-1262) and most
+    candidates repeat — without the cache these tiny-container merkleizations
+    are >half of the 1M-validator distill wall-clock."""
+    key = (int(c.shard), int(c.start_epoch), int(c.end_epoch),
+           bytes(c.parent_root), bytes(c.data_root))
+    r = ctx.cl_roots.get(key)
+    if r is None:
+        r = ctx.cl_roots[key] = spec.hash_tree_root(c)
+    return r
 
 
 def _committee_count_for_active(spec, active_count: int) -> int:
@@ -524,6 +541,7 @@ def build_epoch_context(spec, state, np_cols: dict = None) -> EpochContext:
         prev_atts=prev_atts, curr_atts=curr_atts,
         prev_parts=_decode_participants(spec, layouts, prev_atts),
         curr_parts=_decode_participants(spec, layouts, curr_atts),
+        cl_roots={},
     )
 
 
@@ -566,7 +584,10 @@ def _crosslink_winners(spec, state, ctx: EpochContext, epoch: int):
     atts = ctx.curr_atts if epoch == current_epoch else ctx.prev_atts
     parts = ctx.curr_parts if epoch == current_epoch else ctx.prev_parts
     lay = ctx.layouts[epoch]
-    htr = spec.hash_tree_root
+
+    def htr(c):
+        return _crosslink_root(spec, ctx, c)
+
     default_cl = spec.Crosslink()
     default_root = htr(default_cl)
 
