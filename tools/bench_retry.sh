@@ -56,5 +56,14 @@ while true; do
     else
         echo "$ts probe FAILED (wedged relay?)" >> bench_logs/probe_history.log
     fi
+    # Persist the probe history hourly so the wedge evidence survives
+    # even if this loop is killed between successes.
+    now=$(date +%s)
+    if [ "$((now - ${last_hb:-0}))" -ge 3600 ]; then
+        last_hb=$now
+        commit_logs "bench_logs: probe heartbeat
+
+No-Verification-Needed: operational log churn only" || true
+    fi
     sleep 120
 done
