@@ -1,0 +1,38 @@
+"""Pallas pair-hash kernel == XLA kernel == hashlib (interpret mode on CPU;
+the on-chip Mosaic compile is exercised by tools/tpu_followup.py)."""
+import hashlib
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.ops import sha256 as S
+from consensus_specs_tpu.ops.sha256_pallas import sha256_pairs_pallas
+
+
+@pytest.mark.parametrize("n", [1, 5, 128, 300])
+def test_pallas_pairs_match_xla(n):
+    """Ragged sizes cross the lane-padding boundaries (128, 512)."""
+    rng = np.random.default_rng(n)
+    words = rng.integers(0, 2 ** 32, (n, 16), dtype=np.uint32)
+    got = np.asarray(sha256_pairs_pallas(words))
+    want = np.asarray(S.sha256_pairs(words))
+    assert (got == want).all()
+
+
+def test_pallas_pairs_multi_tile_grid():
+    """n=300 at block_lanes=128 runs a 3-step grid: a broken BlockSpec
+    index map (e.g. every step reading tile 0) cannot pass this."""
+    rng = np.random.default_rng(99)
+    words = rng.integers(0, 2 ** 32, (300, 16), dtype=np.uint32)
+    got = np.asarray(sha256_pairs_pallas(words, block_lanes=128))
+    want = np.asarray(S.sha256_pairs(words))
+    assert (got == want).all()
+
+
+def test_pallas_pairs_match_hashlib():
+    msgs = [bytes(range(64)), b"\x00" * 64, b"\xff" * 64]
+    words = np.stack([
+        S.bytes_to_words(np.frombuffer(m, dtype=np.uint8)) for m in msgs])
+    got = np.asarray(sha256_pairs_pallas(words))
+    for k, m in enumerate(msgs):
+        assert S.words_to_bytes(got[k]).tobytes() == hashlib.sha256(m).digest()

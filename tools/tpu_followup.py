@@ -84,6 +84,20 @@ def main():
     assert (a == b).all(), "unrolled != fori on TPU"
     print("sha256 unrolled == fori on chip", flush=True)
 
+    # 4b) Pallas (Mosaic) pair-hash vs XLA kernel on chip + A/B timing
+    from consensus_specs_tpu.ops.sha256_pallas import sha256_pairs_pallas
+    t0 = time.time()
+    p = np.asarray(sha256_pairs_pallas(words, interpret=False))
+    print(f"pallas pair-hash first: {time.time()-t0:.1f}s", flush=True)
+    assert (p == a).all(), "pallas != XLA pair-hash on TPU"
+    for label, fn in (("pallas", lambda: sha256_pairs_pallas(words, interpret=False)),
+                      ("xla", lambda: sha256_pairs(words, unroll=True))):
+        t0 = time.time()
+        for _ in range(3):
+            np.asarray(fn())
+        print(f"sha256 pair-hash {label} steady: {(time.time()-t0)/3*1e3:.1f} ms",
+              flush=True)
+
     # 5) epoch sub-stage profile (which term dominates the ~400 ms?)
     from consensus_specs_tpu.models import phase0
     from consensus_specs_tpu.models.phase0.epoch_soa import (
