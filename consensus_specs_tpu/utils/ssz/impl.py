@@ -156,37 +156,40 @@ def _decode_homogeneous(data: bytes, elem_type: Any, count: Any) -> PyList[Any]:
     return [deserialize(data[offsets[i]:offsets[i + 1]], elem_type) for i in range(n)]
 
 
-def _decode_series(data: bytes, types: PyList[Any]) -> PyList[Any]:
-    # first pass: split fixed region into per-field slices / offsets
+def series_field_spans(data: bytes, types: PyList[Any]
+                       ) -> PyList[Tuple[int, int]]:
+    """Byte span of each field of a serialized field sequence — the SSZ
+    offset grammar (fixed fields in order; variable fields hold 4-byte
+    offsets partitioning the tail monotonically), shared by _decode_series
+    and the checkpoint fast path (utils/ssz/columns.py)."""
     pos = 0
-    slots: PyList[Tuple[Any, Any]] = []  # (typ, bytes | offset)
-    offsets: PyList[int] = []
-    for t in types:
+    spans: PyList[Any] = []
+    pending: PyList[int] = []        # indices of variable-size fields
+    for k, t in enumerate(types):
         if is_fixed_size(t):
             size = fixed_byte_size(t)
-            slots.append((t, data[pos:pos + size]))
+            spans.append((pos, pos + size))
             pos += size
         else:
-            off = int.from_bytes(data[pos:pos + 4], "little")
-            slots.append((t, off))
-            offsets.append(off)
+            spans.append(int.from_bytes(data[pos:pos + 4], "little"))
+            pending.append(k)
             pos += 4
-    if offsets:
-        assert offsets[0] == pos, "first offset must point to end of fixed region"
-        for a, b in zip(offsets, offsets[1:] + [len(data)]):
-            assert a <= b <= len(data), "offsets not monotonic / out of bounds"
+    if pending:
+        assert spans[pending[0]] == pos, \
+            "first offset must point to end of fixed region"
+        ends = [spans[k] for k in pending[1:]] + [len(data)]
+        for k, end in zip(pending, ends):
+            off = spans[k]
+            assert off <= end <= len(data), "offsets not monotonic / out of bounds"
+            spans[k] = (off, end)
     else:
         assert pos == len(data), "trailing bytes after fixed-size container"
-    offsets.append(len(data))
-    values = []
-    vi = 0
-    for t, slot in slots:
-        if isinstance(slot, bytes):
-            values.append(deserialize(slot, t))
-        else:
-            values.append(deserialize(data[offsets[vi]:offsets[vi + 1]], t))
-            vi += 1
-    return values
+    return spans
+
+
+def _decode_series(data: bytes, types: PyList[Any]) -> PyList[Any]:
+    spans = series_field_spans(data, types)
+    return [deserialize(data[a:b], t) for (a, b), t in zip(spans, types)]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,77 @@
+"""Checkpoint fast path: SSZ BeaconState bytes -> SoA columns, diffed
+against the object-model walk (epoch_soa.columns_np_from_state)."""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.models import phase0, phase1
+from consensus_specs_tpu.models.phase0.epoch_soa import columns_np_from_state
+from consensus_specs_tpu.testing.factories import seed_genesis_state
+from consensus_specs_tpu.utils.ssz.columns import (
+    container_field_spans, fixed_field_layout, state_columns_from_bytes)
+from consensus_specs_tpu.utils.ssz.impl import serialize
+from consensus_specs_tpu.utils.ssz.typing import List as SSZList, uint64
+
+
+def _spec(phase):
+    return (phase0 if phase == 0 else phase1).get_spec("minimal")
+
+
+@pytest.mark.parametrize("phase", [0, 1])
+def test_state_columns_match_object_walk(phase):
+    """Both phases: phase 1 appends custody fields to Validator, so the
+    record stride differs while the phase-0 offsets must not move."""
+    bls.bls_active = False
+    spec = _spec(phase)
+    state = seed_genesis_state(spec, 37)
+    # make the columns non-trivial
+    state.validator_registry[3].slashed = True
+    state.validator_registry[5].exit_epoch = 7
+    state.balances[11] = 12345
+    data = serialize(state, spec.BeaconState)
+    cols = state_columns_from_bytes(data, spec)
+    want = columns_np_from_state(state)
+    for key, w in want.items():
+        assert (np.asarray(cols[key]) == np.asarray(w)).all(), key
+    pubs = np.stack([np.frombuffer(bytes(v.pubkey), np.uint8)
+                     for v in state.validator_registry])
+    assert (cols["pubkey"] == pubs).all()
+
+
+def test_phase1_stride_grows_offsets_stable():
+    l0, s0 = fixed_field_layout(_spec(0).Validator)
+    l1, s1 = fixed_field_layout(_spec(1).Validator)
+    assert s1 > s0, "phase-1 Validator must append fields"
+    for name, span in l0.items():
+        assert l1[name] == span, f"phase-0 offset moved: {name}"
+
+
+def test_corrupt_bool_byte_rejected():
+    """A non-0/1 slashed byte must fail loudly (deserialize_basic parity),
+    not resume as slashed=True."""
+    bls.bls_active = False
+    spec = _spec(0)
+    state = seed_genesis_state(spec, 4)
+    data = bytearray(serialize(state, spec.BeaconState))
+    spans = container_field_spans(bytes(data), spec.BeaconState)
+    layout, stride = fixed_field_layout(spec.Validator)
+    off, _ = layout["slashed"]
+    lo, _ = spans["validator_registry"]
+    data[lo + 2 * stride + off] = 0x02
+    with pytest.raises(AssertionError, match="bool"):
+        state_columns_from_bytes(bytes(data), spec)
+
+
+def test_field_spans_match_serialization():
+    """Variable-field spans slice back to payloads the deserializer agrees
+    with (registry payload length == V * stride)."""
+    bls.bls_active = False
+    spec = _spec(0)
+    state = seed_genesis_state(spec, 9)
+    data = serialize(state, spec.BeaconState)
+    spans = container_field_spans(data, spec.BeaconState)
+    _, stride = fixed_field_layout(spec.Validator)
+    lo, hi = spans["validator_registry"]
+    assert (hi - lo) == 9 * stride
+    lo, hi = spans["balances"]
+    assert data[lo:hi] == serialize(list(state.balances), SSZList[uint64])
