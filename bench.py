@@ -484,47 +484,90 @@ def main():
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+    # Device stages run in sequence; if the flaky relay dies mid-run
+    # (observed: "TPU backend setup/compile error (Unavailable)" 45 min into
+    # a window) every stage measured so far still gets emitted. The headline
+    # metric (s2s + BLS batch) keeps its name when both components were
+    # measured; otherwise it is renamed "_partial" — honest about
+    # incomparability, but a recorded number instead of rc=1 with no JSON.
+    # Only relay-shaped failures are absorbed (RuntimeError covers jax's
+    # JaxRuntimeError, OSError the tunnel): deterministic code bugs still
+    # crash with rc=1 so the retry loop's failure signal stays honest.
+    device_error = None
+
+    def _device(label, fn):
+        nonlocal device_error
+        if device_error is not None:
+            return None
+        try:
+            return fn()
+        except (RuntimeError, OSError) as e:
+            device_error = f"{type(e).__name__}: {e}".splitlines()[0][:200]
+            _progress(f"{label} lost the device, continuing: {device_error}")
+            return None
+
     _progress(f"state-to-state epoch ({V_STATE} validators, real BeaconState)")
-    tm = bench_state_to_state()
+    tm = _device("state-to-state", bench_state_to_state)
+    if tm is None:
+        raise RuntimeError(f"no stage completed: {device_error}")
     s2s_ms = (tm["distill"] + tm["device"] + tm["root"]) * 1e3
-    _progress(
-        "state-to-state %.0f ms (distill %.0f, device %.0f, root %.0f; "
-        "writeback %.0f); kernel epoch+shuffle (%d validators)"
-        % (s2s_ms, tm["distill"] * 1e3, tm["device"] * 1e3, tm["root"] * 1e3,
-           tm["writeback"] * 1e3, V_DEVICE))
-    t_epoch = bench_epoch_device()
-    _progress(f"epoch {t_epoch * 1e3:.1f} ms; state root ({V_DEVICE} validators)")
-    t_root = bench_state_root_device()
-    _progress(f"state root {t_root * 1e3:.1f} ms; BLS batch ({N_ATTESTATIONS} groups)")
-    t_bls, t_py_verify = bench_bls_device()
-    _progress(f"BLS batch {t_bls * 1e3:.1f} ms; config-3 block "
-              f"({N_ATTESTATIONS} real attestations, end-to-end)")
-    t_block = bench_block_device()
-    _progress(f"config-3 block {t_block * 1e3:.0f} ms; python baseline")
+    s2s_txt = ("s2s %.0f ms = distill %.0f + epoch %.0f + root %.0f, "
+               "writeback %.0f ms excl." % (
+                   s2s_ms, tm["distill"] * 1e3, tm["device"] * 1e3,
+                   tm["root"] * 1e3, tm["writeback"] * 1e3))
+    _progress(f"{s2s_txt}; kernel epoch+shuffle ({V_DEVICE} validators)")
+    t_epoch = _device("epoch kernel", bench_epoch_device)
+    if t_epoch is not None:
+        _progress(f"epoch {t_epoch * 1e3:.1f} ms; state root ({V_DEVICE} validators)")
+    t_root = _device("state-root kernel", bench_state_root_device)
+    if t_root is not None:
+        _progress(f"state root {t_root * 1e3:.1f} ms; BLS batch ({N_ATTESTATIONS} groups)")
+    bls_res = _device("BLS batch", bench_bls_device)
+    t_bls, t_py_verify = bls_res if bls_res is not None else (None, None)
+    if t_bls is not None:
+        _progress(f"BLS batch {t_bls * 1e3:.1f} ms; config-3 block "
+                  f"({N_ATTESTATIONS} real attestations, end-to-end)")
+    t_block = _device("config-3 block", bench_block_device)
+    if t_block is not None:
+        _progress(f"config-3 block {t_block * 1e3:.0f} ms; python baseline")
     py_epoch, py_root = bench_python_baseline()
     _progress("done")
 
-    total_ms = s2s_ms + t_bls * 1e3
-    aggverify_per_s = N_ATTESTATIONS / t_bls
     # python equivalents, scaled per validator / per verify (the python
     # object path at 1M is hours; scaling is linear in V and N)
     scale = V_STATE / V_BASELINE
-    py_total_ms = (py_epoch * scale + py_root * scale
-                   + t_py_verify * N_ATTESTATIONS) * 1e3
-    metric = ("config5_1M_validator_slot_boundary_ms" if V_STATE == 1_000_000
-              else f"config5_{V_STATE}_validator_slot_boundary_ms")
+    base = ("config5_1M_validator_slot_boundary_ms" if V_STATE == 1_000_000
+            else f"config5_{V_STATE}_validator_slot_boundary_ms")
+    parts = [s2s_txt]
+    if t_epoch is not None:
+        parts.append("kernel epoch %.1f ms" % (t_epoch * 1e3))
+    if t_root is not None:
+        parts.append("kernel root %.1f ms" % (t_root * 1e3))
+    if t_bls is not None:
+        parts.append("%d-agg-verify %.1f ms = %.0f aggverify/s/chip" % (
+            N_ATTESTATIONS, t_bls * 1e3, N_ATTESTATIONS / t_bls))
+    if t_block is not None:
+        parts.append("config-3 block e2e %.0f ms" % (t_block * 1e3))
+    if t_bls is not None:
+        # both headline components measured: full metric, even if the
+        # auxiliary block stage was lost afterwards
+        total_ms = s2s_ms + t_bls * 1e3
+        py_total_ms = (py_epoch * scale + py_root * scale
+                       + t_py_verify * N_ATTESTATIONS) * 1e3
+        metric = base
+    else:
+        total_ms = s2s_ms
+        py_total_ms = (py_epoch + py_root) * scale * 1e3
+        metric = base.replace("_ms", "_partial_ms")
+    if device_error is not None:
+        parts.append("device lost mid-run (%s) — later stages missing"
+                     % device_error)
+    parts.append("python baseline %.0f ms scaled over the measured stages"
+                 % py_total_ms)
     print(json.dumps({
         "metric": metric,
         "value": round(total_ms, 1),
-        "unit": ("ms state-to-state+BLS (s2s %.0f ms = distill %.0f + epoch "
-                 "%.0f + root %.0f, writeback %.0f ms excl.; kernel epoch "
-                 "%.1f ms, kernel root %.1f ms; %d-agg-verify %.1f ms = %.0f "
-                 "aggverify/s/chip; config-3 block e2e %.0f ms; python "
-                 "baseline %.0f ms scaled)"
-                 % (s2s_ms, tm["distill"] * 1e3, tm["device"] * 1e3,
-                    tm["root"] * 1e3, tm["writeback"] * 1e3, t_epoch * 1e3,
-                    t_root * 1e3, N_ATTESTATIONS, t_bls * 1e3,
-                    aggverify_per_s, t_block * 1e3, py_total_ms)),
+        "unit": "ms (%s)" % "; ".join(parts),
         "vs_baseline": round(py_total_ms / total_ms, 1),
     }))
 

@@ -48,7 +48,10 @@ while true; do
         frc=$?
         echo "followup rc=$frc" >> "$flog"
         commit_logs "bench_logs: TPU run $ts (bench rc=$rc, followup rc=$frc)"
-        if [ "$rc" -eq 0 ] && [ "$frc" -eq 0 ]; then
+        # an incomplete capture (relay died mid-run; bench.py still exits 0
+        # and flags the JSON's unit string) must not stop the loop
+        if [ "$rc" -eq 0 ] && [ "$frc" -eq 0 ] \
+                && ! grep -q 'lost mid-run' "$bjson"; then
             echo "$ts" > bench_logs/SUCCESS
             commit_logs "bench_logs: verified TPU bench + followup pass $ts"
             exit 0
