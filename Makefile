@@ -8,7 +8,7 @@ PYTHON ?= python
 VECTOR_DIR ?= out/vectors
 JUNIT ?= out/test-results.xml
 
-.PHONY: test citest citest-mainnet lint vectors vectors-minimal bench multichip smoke clean
+.PHONY: test citest citest-mainnet lint vectors vectors-minimal bench bench-cpu multichip smoke clean
 
 # Full suite on the virtual CPU mesh (the conftest pins devices).
 test:
@@ -41,6 +41,13 @@ vectors-minimal:
 # Headline benchmark (real TPU when present; CSTPU_BENCH_CPU=1 to smoke).
 bench:
 	$(PYTHON) bench.py
+
+# Reproducible off-chip capture: the identical harness pinned to XLA:CPU.
+# Committed bench_logs/bench_cpu_*.json artifacts use V=65536 (smoke scale)
+# and V=1000000 (headline scale); override V to match the one to reproduce.
+bench-cpu:
+	CSTPU_BENCH_CPU=1 CSTPU_BENCH_V=$(or $(V),65536) \
+	CSTPU_BENCH_ATT=32 $(PYTHON) bench.py
 
 # The driver's multi-chip dry run, locally on 8 virtual devices.
 multichip:
