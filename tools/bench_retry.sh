@@ -33,21 +33,32 @@ while true; do
             > bench_logs/probe_last.log 2>&1; then
         echo "$ts probe OK: $(tail -1 bench_logs/probe_last.log)" \
             >> bench_logs/probe_history.log
+        # Phase 1 — incremental pairing compile warming, smallest shape
+        # first (G=1 proves Mosaic feasibility + on-chip correctness in
+        # minutes; each rung banks into .cache/xla). Logs commit BEFORE the
+        # long bench so a relay death mid-bench cannot lose this evidence.
+        wlog="bench_logs/warm_${ts}.log"
+        PYTHONUNBUFFERED=1 timeout 4500 python tools/tpu_warm.py \
+            > "$wlog" 2>&1
+        wrc=$?
+        echo "warm rc=$wrc" >> "$wlog"
+        commit_logs "bench_logs: TPU warm pass $ts (rc=$wrc)"
         blog="bench_logs/bench_${ts}.log"
         bjson="bench_logs/bench_${ts}.json"
-        # 5400s: a fresh-cache first success needs epoch + root + two
-        # grouped-pairing shapes (~470s each) + the block pipeline compiled
-        # in one attempt; the persistent cache still carries partial
+        # 5400s: with the warm pass banking the pairing compiles, a bench
+        # attempt needs epoch + root (cached from earlier windows) + the
+        # block pipeline; the persistent cache still carries partial
         # progress into the next attempt if this one times out
         PYTHONUNBUFFERED=1 timeout 5400 python bench.py > "$bjson" 2> "$blog"
         rc=$?
         echo "bench rc=$rc" >> "$blog"
+        commit_logs "bench_logs: TPU bench $ts (rc=$rc)"
         flog="bench_logs/followup_${ts}.log"
         PYTHONUNBUFFERED=1 timeout 3600 python tools/tpu_followup.py \
             > "$flog" 2>&1
         frc=$?
         echo "followup rc=$frc" >> "$flog"
-        commit_logs "bench_logs: TPU run $ts (bench rc=$rc, followup rc=$frc)"
+        commit_logs "bench_logs: TPU followup $ts (rc=$frc)"
         # an incomplete capture (relay died mid-run; bench.py still exits 0
         # and flags the JSON's unit string) must not stop the loop
         if [ "$rc" -eq 0 ] && [ "$frc" -eq 0 ] \

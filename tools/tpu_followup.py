@@ -8,10 +8,15 @@ next optimization targets the real bottleneck.
 
 Usage: python tools/tpu_followup.py  (from the repo root)
 """
+import os
 import sys
 import time
 
 import numpy as np
+
+# `python tools/tpu_followup.py` puts tools/ (not the repo root) on
+# sys.path; the package and bench live at the root.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def sync(x):

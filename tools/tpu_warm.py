@@ -1,0 +1,101 @@
+"""Incremental TPU compile-cache warming for the grouped BLS pairing.
+
+The axon relay wedges for hours and has died mid-compile in every round so
+far; the grouped pairing (the framework's defining kernel) has therefore
+never executed on real silicon. This tool makes every relay window bank
+durable progress:
+
+  * smallest shape FIRST: G=1 proves Mosaic compile-feasibility AND
+    on-chip correctness of the pairing in the first minutes of a window;
+  * then the ladder climbs to the bench shape (G=128), each rung landing
+    in the persistent compile cache (.cache/xla) independently — a window
+    that dies between rungs still leaves every finished compile on disk
+    for the next attempt (and for bench.py, which shares the cache);
+  * a heartbeat thread prints elapsed time every 60 s so a dead window is
+    diagnosable from the log (silent 35-minute hangs killed round 4's
+    only window).
+
+Each rung verifies the staged signatures actually pass on chip (a [G]
+all-true verdict), so the first successful rung is the first hardware
+evidence for specs/bls_signature.md:139-146 semantics.
+
+Usage: python tools/tpu_warm.py [G ...]   (default ladder: 1 8 128)
+"""
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+# `python tools/tpu_warm.py` puts tools/ (not the repo root) on sys.path;
+# the package and bench live at the root.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_T0 = time.time()
+
+
+def _say(msg):
+    print(f"[warm +{time.time() - _T0:.0f}s] {msg}", flush=True)
+
+
+def _heartbeat():
+    while True:
+        time.sleep(60)
+        _say("heartbeat (still alive; compile in progress?)")
+
+
+def main(ladder):
+    threading.Thread(target=_heartbeat, daemon=True).start()
+
+    import jax
+    # CSTPU_WARM_CPU=1 pins the host backend for harness smoke tests; the
+    # config API is the only pin that works once the site hook pre-imported
+    # jax (env-var JAX_PLATFORMS is read at import time — same as bench.py).
+    if os.environ.get("CSTPU_WARM_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "..", ".cache", "xla")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    _say(f"devices: {jax.devices()}")
+
+    import jax.numpy as jnp
+    from consensus_specs_tpu.ops.bls_jax import (
+        grouped_pairing_check, stage_example_groups)
+
+    # Stage the largest rung once on the host (pure-bignum signing is slow)
+    # and slice the smaller rungs out of it: all rungs share group values,
+    # so a verdict mismatch between rungs would be a real device bug.
+    g_max = max(ladder)
+    _say(f"staging {g_max} signature groups on host")
+    g1_all, g2_all = stage_example_groups(g_max)
+    _say("staging done")
+
+    for G in ladder:
+        dg1 = jnp.asarray(g1_all[:G])
+        dg2 = jnp.asarray(g2_all[:G])
+        jax.block_until_ready((dg1, dg2))
+        _say(f"G={G}: compiling + running grouped pairing "
+             f"({3 * G} Miller loops + batched final exp)")
+        t0 = time.time()
+        ok = np.asarray(grouped_pairing_check(dg1, dg2))
+        t_first = time.time() - t0
+        if not bool(ok.all()):
+            _say(f"G={G}: VERDICT FAILED on chip: {ok}")
+            return 1
+        t0 = time.time()
+        np.asarray(grouped_pairing_check(dg1, dg2))
+        t_steady = time.time() - t0
+        _say(f"G={G}: OK on chip — first {t_first:.1f}s (incl. compile), "
+             f"steady {t_steady * 1e3:.0f} ms "
+             f"({G / t_steady:.1f} aggverify/s)")
+
+    _say("ALL RUNGS PASSED — pairing cache warm for bench.py")
+    return 0
+
+
+if __name__ == "__main__":
+    ladder = [int(a) for a in sys.argv[1:]] or [1, 8, 128]
+    sys.exit(main(ladder))
