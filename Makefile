@@ -8,16 +8,31 @@ PYTHON ?= python
 VECTOR_DIR ?= out/vectors
 JUNIT ?= out/test-results.xml
 
-.PHONY: test citest citest-mainnet lint vectors vectors-minimal bench bench-cpu multichip smoke clean
+.PHONY: test testall citest citest-cov citest-mainnet lint vectors vectors-minimal bench bench-cpu multichip smoke clean
 
-# Full suite on the virtual CPU mesh (the conftest pins devices).
+COV_FLOOR ?= 80
+
+# Default lane: the suite minus the `slow`-marked modules (pairing corpus,
+# state-to-state) — sub-10-minute on the virtual CPU mesh (VERDICT r4 #8).
 test:
+	$(PYTHON) -m pytest tests/ -q -m "not slow"
+
+# Everything, including slow.
+testall:
 	$(PYTHON) -m pytest tests/ -q
 
-# CI flavor: fail fast, machine-readable results.
+# CI flavor: full suite, fail fast, machine-readable results.
 citest:
 	mkdir -p $(dir $(JUNIT))
 	$(PYTHON) -m pytest tests/ -x -q --junitxml=$(JUNIT)
+
+# CI coverage gate (VERDICT r4 missing #2; reference Makefile:49-58 runs
+# --cov): full suite under the stdlib line tracer (tools/cov.py), then
+# fail below the floor. Artifact: out/coverage.json.
+citest-cov:
+	mkdir -p $(dir $(JUNIT))
+	CSTPU_COV=1 $(PYTHON) -m pytest tests/ -x -q --junitxml=$(JUNIT)
+	$(PYTHON) tools/cov.py --check --floor $(COV_FLOOR)
 
 # Preset-divergence gate: the corpus subset where mainnet differs most from
 # minimal (committee counts 64 vs 8, 90 vs 10 shuffle rounds, 64-slot

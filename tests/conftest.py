@@ -45,11 +45,31 @@ if os.environ.get("CSTPU_ACCEL") == "1":
     install_device_shuffler()
 
 
+# Line-coverage collection (tools/cov.py, stdlib sys.monitoring): opt-in
+# because the artifact write belongs to the CI lane (make citest-cov), not
+# every local run. Near-zero steady overhead (per-location DISABLE).
+if os.environ.get("CSTPU_COV") == "1":
+    import importlib.util
+    _root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _cspec = importlib.util.spec_from_file_location(
+        "cstpu_cov", os.path.join(_root, "tools", "cov.py"))
+    _cov = importlib.util.module_from_spec(_cspec)
+    _cspec.loader.exec_module(_cov)
+    _cov.start(os.path.join(_root, "consensus_specs_tpu"))
+
+
 def pytest_addoption(parser):
     parser.addoption(
         "--preset", action="store", default="minimal",
         help="constant preset to run spec tests under (minimal/mainnet)",
     )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running (pairing corpus / state-to-state) — excluded "
+        "from the default `make test` lane, included in `make citest`")
 
 
 @pytest.fixture(scope="session")

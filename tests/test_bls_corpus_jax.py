@@ -16,6 +16,8 @@ import importlib
 
 import pytest
 
+pytestmark = pytest.mark.slow  # pairing compiles dominate suite wall-clock
+
 from consensus_specs_tpu.crypto import bls
 
 # (table module, case name) — kept small: every row here signs and/or

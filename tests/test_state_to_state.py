@@ -13,6 +13,8 @@ from copy import deepcopy
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # pairing compiles dominate suite wall-clock
+
 import bench
 from consensus_specs_tpu.crypto import bls
 from consensus_specs_tpu.models import phase0
