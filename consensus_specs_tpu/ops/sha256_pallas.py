@@ -160,11 +160,15 @@ def sha256_pairs_pallas(words: jnp.ndarray, *, block_lanes: int = 512,
                         interpret: bool | None = None) -> jnp.ndarray:
     """[N, 16] uint32 big-endian words -> [N, 8] digests; == sha256_pairs.
 
-    interpret=None auto-selects: Mosaic on an accelerator backend, the
-    Pallas interpreter on CPU (where the TPU lowering does not exist).
+    interpret=None auto-selects: Mosaic on TPU, the Pallas interpreter
+    everywhere else (the Mosaic lowering exists only for TPU — GPU
+    backends would fail on the compiled path, not fall back). The check
+    reads the device's platform, not jax.default_backend(): the tunneled
+    TPU registers under the plugin's platform name ("axon") while its
+    devices still report platform "tpu".
     """
     if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+        interpret = jax.devices()[0].platform != "tpu"
     assert block_lanes % _LANE == 0, "block_lanes must be lane-aligned"
     wt = jnp.transpose(jnp.asarray(words, jnp.uint32), (1, 0))
     run = _pairs_transposed if interpret else _pairs_transposed_jit

@@ -61,7 +61,11 @@ def registry_columns_from_bytes(reg_bytes, validator_type: Any
     byte-vector fields (pubkey, withdrawal_credentials) as [V, size] uint8."""
     layout, stride = fixed_field_layout(validator_type)
     n = len(reg_bytes)
-    assert n % stride == 0, "registry payload is not a whole number of records"
+    # Checkpoint-integrity checks are real raises, not asserts: the module
+    # contract is that a corrupted checkpoint MUST fail here, and python -O
+    # strips asserts (same convention as fq_tower's _check_budget).
+    if n % stride != 0:
+        raise ValueError("registry payload is not a whole number of records")
     recs = np.frombuffer(reg_bytes, dtype=np.uint8).reshape(n // stride, stride)
     cols: Dict[str, np.ndarray] = {}
     for name, t in zip(validator_type.get_field_names(),
@@ -71,8 +75,8 @@ def registry_columns_from_bytes(reg_bytes, validator_type: Any
             raw = recs[:, off]
             # strict like deserialize_basic: a corrupted checkpoint must
             # fail here, not resume with a silently-true flag
-            assert ((raw == 0) | (raw == 1)).all(), \
-                f"{name}: invalid bool encoding"
+            if not ((raw == 0) | (raw == 1)).all():
+                raise ValueError(f"{name}: invalid bool encoding")
             cols[name] = raw.astype(bool)
         elif is_uint_type(t):
             assert size == 8, f"{name}: only uint64 columns are supported"
@@ -93,9 +97,10 @@ def state_columns_from_bytes(state_bytes: bytes, spec) -> Dict[str, np.ndarray]:
     cols = registry_columns_from_bytes(memoryview(state_bytes)[lo:hi],
                                        spec.Validator)
     lo, hi = spans["balances"]
-    assert (hi - lo) % 8 == 0
+    if (hi - lo) % 8 != 0:
+        raise ValueError("balances payload is not a whole number of uint64s")
     cols["balance"] = np.frombuffer(state_bytes, dtype="<u8",
                                     count=(hi - lo) // 8, offset=lo).copy()
-    assert cols["slashed"].shape == cols["balance"].shape, \
-        "registry and balances lengths disagree"
+    if cols["slashed"].shape != cols["balance"].shape:
+        raise ValueError("registry and balances lengths disagree")
     return cols

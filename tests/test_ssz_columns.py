@@ -58,7 +58,7 @@ def test_corrupt_bool_byte_rejected():
     off, _ = layout["slashed"]
     lo, _ = spans["validator_registry"]
     data[lo + 2 * stride + off] = 0x02
-    with pytest.raises(AssertionError, match="bool"):
+    with pytest.raises(ValueError, match="bool"):
         state_columns_from_bytes(bytes(data), spec)
 
 
