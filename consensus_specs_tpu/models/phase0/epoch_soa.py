@@ -26,6 +26,7 @@ bit-for-bit.
 """
 from __future__ import annotations
 
+import itertools
 import operator
 from functools import partial
 from typing import NamedTuple
@@ -457,13 +458,50 @@ def _crosslink_root(spec, ctx: "EpochContext", c) -> bytes:
     process_crosslinks + the deltas pass re-selecting against the updated
     records, mirroring process_epoch's ordering :1251-1262) and most
     candidates repeat — without the cache these tiny-container merkleizations
-    are >half of the 1M-validator distill wall-clock."""
+    are >half of the 1M-validator distill wall-clock. build_epoch_context
+    pre-fills the cache in one vectorized batch (_prefill_crosslink_roots);
+    this per-record path is the fallback for records created mid-pass."""
     key = (int(c.shard), int(c.start_epoch), int(c.end_epoch),
            bytes(c.parent_root), bytes(c.data_root))
     r = ctx.cl_roots.get(key)
     if r is None:
         r = ctx.cl_roots[key] = spec.hash_tree_root(c)
     return r
+
+
+def _prefill_crosslink_roots(spec, ctx: "EpochContext", state) -> None:
+    """Batch every Crosslink merkleization the winner-selection passes will
+    query — the state's records + each attestation's candidate + the
+    default — into ONE [N, 8, 32] subtree_roots_batch call instead of ~2k
+    recursive per-container hash_tree_root walks (those were ~1.2 s of the
+    1M-validator distill). Chunk layout per container Merkleization rules
+    (simple-serialize.md:134-145): 5 field leaves (three uint64, two
+    Bytes32) padded to the next power of two."""
+    from ...utils.ssz import bulk
+    keys = {}
+    for c in itertools.chain(
+            state.current_crosslinks,
+            (a.data.crosslink for a in ctx.prev_atts),
+            (a.data.crosslink for a in ctx.curr_atts),
+            (spec.Crosslink(),)):
+        key = (int(c.shard), int(c.start_epoch), int(c.end_epoch),
+               bytes(c.parent_root), bytes(c.data_root))
+        if key not in keys and key not in ctx.cl_roots:
+            keys[key] = None
+    if not keys:
+        return
+    ks = list(keys)
+    n = len(ks)
+    leaves = np.zeros((n, 8, 32), dtype=np.uint8)
+    u64s = np.array([(k[0], k[1], k[2]) for k in ks], dtype="<u8")
+    leaves[:, 0:3, :8] = u64s.view(np.uint8).reshape(n, 3, 8)
+    leaves[:, 3, :] = np.frombuffer(b"".join(k[3] for k in ks),
+                                    np.uint8).reshape(n, 32)
+    leaves[:, 4, :] = np.frombuffer(b"".join(k[4] for k in ks),
+                                    np.uint8).reshape(n, 32)
+    roots = bulk.subtree_roots_batch(leaves)
+    for i, k in enumerate(ks):
+        ctx.cl_roots[k] = roots[i].tobytes()
 
 
 def _committee_count_for_active(spec, active_count: int) -> int:
@@ -562,13 +600,15 @@ def build_epoch_context(spec, state, np_cols: dict = None) -> EpochContext:
     for e in {previous_epoch, current_epoch}.union(
             int(a.data.target_epoch) for a in prev_atts + curr_atts):
         layouts[e] = _epoch_layout(spec, state, np_cols, e)
-    return EpochContext(
+    ctx = EpochContext(
         n=len(state.validator_registry), np_cols=np_cols, layouts=layouts,
         prev_atts=prev_atts, curr_atts=curr_atts,
         prev_parts=_decode_participants(spec, layouts, prev_atts),
         curr_parts=_decode_participants(spec, layouts, curr_atts),
         cl_roots={},
     )
+    _prefill_crosslink_roots(spec, ctx, state)
+    return ctx
 
 
 def _union_flags(n: int, parts_iter) -> np.ndarray:
