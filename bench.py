@@ -349,10 +349,14 @@ def bench_block_device() -> float:
         bls.set_backend("python")
 
 
-def bench_state_to_state():
+def bench_state_to_state(prebuilt_state=None):
     """Config-5 as a TRUE state-to-state measurement (VERDICT r3 #2): an
     actual V_STATE-validator mainnet BeaconState with a full epoch of
     attestations in; updated state + device state root out.
+
+    Returns (timings, post_state): the transitioned state is handed to
+    bench_resident so the ~30 s host-side 1M-state construction is paid
+    once per bench run, not once per stage.
 
     Returned dict: distill (vectorized input distillation incl. 2 device
     shuffles + upload), device (the one-program epoch transition, output-
@@ -378,7 +382,8 @@ def bench_state_to_state():
     install_device_shuffler()
     spec = phase0.get_spec("mainnet")
     V = V_STATE
-    state = build_baseline_state(spec, V)
+    state = (prebuilt_state if prebuilt_state is not None
+             else build_baseline_state(spec, V))
 
     # Registry identity columns (pubkeys/withdrawal_credentials) are static
     # across the epoch; production keeps them device-resident.
@@ -412,7 +417,134 @@ def bench_state_to_state():
         dev_cols.withdrawable_epoch, dev_cols.slashed,
         dev_cols.effective_balance, dev_cols.balance)
     tm["root"] = time.perf_counter() - t0
-    return tm
+    return tm, state
+
+
+def bench_resident(n_epochs: int = 3, resumed_state=None):
+    """Config-5 the way production runs it (VERDICT r4 #2): enter residency
+    ONCE, then drive `n_epochs` consecutive epochs with the registry and
+    balances never leaving the device. Per-epoch boundary cost =
+      stage    host distillation straight off the mirrors (no object walk;
+               committee permutations reused from the epoch's cache)
+      device   the one-program epoch transition on the resident columns
+      refresh  3-column mirror download + cached device registry/balances
+               root recompute + byte-rooted final updates
+    plus "slots": the epoch's 64 per-slot full-state roots (device big-field
+    roots cached; host-memoized small fields). Attestations are synthesized
+    per slot against the live state (real committee layout, full
+    participation) as staging, exactly what arriving blocks would append —
+    block-path costs are measured by bench_block_device, not here.
+
+    Bit-equality of this pipeline vs the object model is gated at reduced V
+    in tests/test_resident.py; this stage measures the 1M steady state.
+
+    Returns a list of per-epoch timing dicts (epoch 0 warms compiles and is
+    reported separately by the caller)."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.models import phase0
+    from consensus_specs_tpu.models.phase0.epoch_soa import _epoch_layout
+    from consensus_specs_tpu.models.phase0.resident import ResidentCore
+    from consensus_specs_tpu.ops.shuffle import install_device_shuffler
+
+    bls.bls_active = False
+    install_device_shuffler()
+    spec = phase0.get_spec("mainnet")
+    if resumed_state is not None:
+        # bench_state_to_state's post-state: its epoch transition ran via
+        # process_epoch_soa (slot NOT yet incremented past the boundary
+        # slot — the bench calls it directly, outside process_slots).
+        # Completing the increment resumes a consistent mid-chain state;
+        # the drive's first measured boundary is then a full epoch away.
+        state = resumed_state
+        state.slot += 1
+    else:
+        state = build_baseline_state(spec, V_STATE)
+    spec.clear_caches()
+    core = ResidentCore(spec, state)
+
+    def synth_slot_attestations(lay, slot, target_epoch, source, store):
+        """Full-participation PendingAttestations for every committee of
+        `slot` (committee layout from the resident mirrors). `target_epoch`
+        / `source` (justified pair) / `store` distinguish in-epoch arrivals
+        from the boundary slot's, which land after rotation in the
+        previous-epoch list with previous-justified source."""
+        cps = lay.count // spec.SLOTS_PER_EPOCH
+        start_slot = spec.get_epoch_start_slot(target_epoch)
+        for off in range((slot - start_slot) * cps, (slot - start_slot + 1) * cps):
+            shard = (lay.start_shard + off) % spec.SHARD_COUNT
+            committee = lay.shuffled[lay.bounds[off]:lay.bounds[off + 1]]
+            data = spec.AttestationData(
+                beacon_block_root=spec.get_block_root_at_slot(state, slot),
+                source_epoch=source[0],
+                source_root=source[1],
+                target_epoch=target_epoch,
+                target_root=spec.get_block_root(state, target_epoch),
+                crosslink=spec.Crosslink(
+                    shard=shard,
+                    parent_root=spec.hash_tree_root(state.current_crosslinks[shard]),
+                    end_epoch=min(target_epoch, state.current_crosslinks[shard].end_epoch
+                                  + spec.MAX_EPOCHS_PER_CROSSLINK),
+                ),
+            )
+            size = len(committee)
+            bitfield = bytearray(b"\xff" * (size // 8))
+            if size % 8:
+                bitfield.append((1 << (size % 8)) - 1)
+            store.append(spec.PendingAttestation(
+                aggregation_bitfield=bytes(bitfield),
+                data=data,
+                inclusion_delay=spec.MIN_ATTESTATION_INCLUSION_DELAY,
+                proposer_index=int(committee[0]),
+            ))
+
+    results = []
+    lay = None
+    try:
+        for _ in range(n_epochs):
+            t_slots = 0.0
+            while True:
+                t0 = time.perf_counter()
+                core._process_slot(state)
+                t_slots += time.perf_counter() - t0
+                # same ordering as ResidentCore.process_slots (the path
+                # bit-equality-tested in tests/test_resident.py): the epoch
+                # transition runs BEFORE the slot increments
+                if (state.slot + 1) % spec.SLOTS_PER_EPOCH == 0:
+                    ended_epoch = spec.get_current_epoch(state)
+                    t0 = time.perf_counter()
+                    core.process_epoch_resident(state)
+                    total = time.perf_counter() - t0
+                    results.append(dict(core.timings, slots=t_slots, total=total))
+                    state.slot += 1
+                    # the boundary slot's attestations arrive on the real
+                    # chain AFTER rotation, into the previous-epoch list
+                    # with the previous-justified source — keep the next
+                    # boundary at genuine full participation (64/64 slots)
+                    if lay is not None:
+                        synth_slot_attestations(
+                            lay, state.slot - 1, ended_epoch,
+                            (state.previous_justified_epoch,
+                             state.previous_justified_root),
+                            state.previous_epoch_attestations)
+                    lay = None   # rotation: next epoch's layout is fresh
+                    break
+                state.slot += 1
+                # staging (unmeasured): the attestations blocks would have
+                # carried for the slot that just completed
+                if lay is None:
+                    ep = spec.get_current_epoch(state)
+                    lay = _epoch_layout(spec, state, core.mirrors, ep)
+                synth_slot_attestations(
+                    lay, state.slot - 1, spec.get_current_epoch(state),
+                    (state.current_justified_epoch,
+                     state.current_justified_root),
+                    state.current_epoch_attestations)
+    finally:
+        # the spec is a cached singleton: residency overrides MUST come off
+        # even when a relay loss aborts mid-drive, or every later bench
+        # stage (incl. the host-only python baseline) runs monkey-patched
+        core.exit()
+    return results
 
 
 def bench_python_baseline():
@@ -490,9 +622,19 @@ def main():
     # metric (s2s + BLS batch) keeps its name when both components were
     # measured; otherwise it is renamed "_partial" — honest about
     # incomparability, but a recorded number instead of rc=1 with no JSON.
-    # Only relay-shaped failures are absorbed (RuntimeError covers jax's
-    # JaxRuntimeError, OSError the tunnel): deterministic code bugs still
-    # crash with rc=1 so the retry loop's failure signal stays honest.
+    # Only relay-shaped failures are absorbed. JAX surfaces deterministic
+    # compile/shape bugs as RuntimeError subclasses too, so a bare
+    # RuntimeError catch would record a real regression as "device lost"
+    # with rc=0 and spin the retry loop forever — instead, match the
+    # status strings the wedged tunnel actually produces and re-raise
+    # anything else (deterministic code bugs still exit rc=1).
+    # Status strings only — a generic "backend setup/compile error" match
+    # would re-absorb deterministic compile regressions (the relay wraps
+    # those with a status too, e.g. "(Unavailable)" vs "(InvalidArgument)";
+    # only the transport-shaped statuses mean the device was lost).
+    _RELAY_MARKERS = ("UNAVAILABLE", "Unavailable", "DEADLINE_EXCEEDED",
+                      "Deadline Exceeded", "Socket closed",
+                      "failed to connect", "Connection reset")
     device_error = None
 
     def _device(label, fn):
@@ -502,20 +644,42 @@ def main():
         try:
             return fn()
         except (RuntimeError, OSError) as e:
-            device_error = f"{type(e).__name__}: {e}".splitlines()[0][:200]
+            msg = f"{type(e).__name__}: {e}"
+            if isinstance(e, RuntimeError) and not any(
+                    m in msg for m in _RELAY_MARKERS):
+                raise  # deterministic failure, not a relay loss
+            device_error = msg.splitlines()[0][:200]
             _progress(f"{label} lost the device, continuing: {device_error}")
             return None
 
     _progress(f"state-to-state epoch ({V_STATE} validators, real BeaconState)")
-    tm = _device("state-to-state", bench_state_to_state)
-    if tm is None:
+    s2s_res = _device("state-to-state", bench_state_to_state)
+    if s2s_res is None:
         raise RuntimeError(f"no stage completed: {device_error}")
+    tm, s2s_state = s2s_res
     s2s_ms = (tm["distill"] + tm["device"] + tm["root"]) * 1e3
-    s2s_txt = ("s2s %.0f ms = distill %.0f + epoch %.0f + root %.0f, "
+    s2s_txt = ("s2s entry-path %.0f ms = distill %.0f + epoch %.0f + root %.0f, "
                "writeback %.0f ms excl." % (
                    s2s_ms, tm["distill"] * 1e3, tm["device"] * 1e3,
                    tm["root"] * 1e3, tm["writeback"] * 1e3))
-    _progress(f"{s2s_txt}; kernel epoch+shuffle ({V_DEVICE} validators)")
+    _progress(f"{s2s_txt}; resident multi-epoch drive ({V_STATE} validators)")
+    res_epochs = _device(
+        "resident", lambda: bench_resident(resumed_state=s2s_state))
+    resident_ms = None
+    res_txt = None
+    if res_epochs is not None and len(res_epochs) >= 2:
+        # compiles are warm (shared with the s2s stage); the last epoch is
+        # the steady state
+        steady = res_epochs[-1]
+        resident_ms = (steady["stage"] + steady["device"]
+                       + steady["refresh"]) * 1e3
+        res_txt = ("resident per-epoch %.0f ms = stage %.0f + epoch %.0f + "
+                   "refresh(root) %.0f over %d epochs; 64 slot-roots %.0f ms" % (
+                       resident_ms, steady["stage"] * 1e3,
+                       steady["device"] * 1e3, steady["refresh"] * 1e3,
+                       len(res_epochs), steady["slots"] * 1e3))
+        _progress(res_txt)
+    _progress(f"kernel epoch+shuffle ({V_DEVICE} validators)")
     t_epoch = _device("epoch kernel", bench_epoch_device)
     if t_epoch is not None:
         _progress(f"epoch {t_epoch * 1e3:.1f} ms; state root ({V_DEVICE} validators)")
@@ -538,7 +702,12 @@ def main():
     scale = V_STATE / V_BASELINE
     base = ("config5_1M_validator_slot_boundary_ms" if V_STATE == 1_000_000
             else f"config5_{V_STATE}_validator_slot_boundary_ms")
-    parts = [s2s_txt]
+    # headline epoch term: the resident steady-state boundary (production
+    # shape — columns never leave the device); the one-shot entry path
+    # stays reported in the unit string
+    headline_epoch_ms = resident_ms if resident_ms is not None else s2s_ms
+    parts = [res_txt] if res_txt is not None else []
+    parts.append(s2s_txt)
     if t_epoch is not None:
         parts.append("kernel epoch %.1f ms" % (t_epoch * 1e3))
     if t_root is not None:
@@ -551,12 +720,12 @@ def main():
     if t_bls is not None:
         # both headline components measured: full metric, even if the
         # auxiliary block stage was lost afterwards
-        total_ms = s2s_ms + t_bls * 1e3
+        total_ms = headline_epoch_ms + t_bls * 1e3
         py_total_ms = (py_epoch * scale + py_root * scale
                        + t_py_verify * N_ATTESTATIONS) * 1e3
         metric = base
     else:
-        total_ms = s2s_ms
+        total_ms = headline_epoch_ms
         py_total_ms = (py_epoch + py_root) * scale * 1e3
         metric = base.replace("_ms", "_partial_ms")
     if device_error is not None:

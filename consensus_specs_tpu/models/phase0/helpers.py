@@ -159,6 +159,14 @@ def decrease_balance(spec, state, index: int, delta: int) -> None:
     state.balances[index] = 0 if delta > state.balances[index] else state.balances[index] - delta
 
 
+def effective_balance_of(spec, state, index: int) -> int:
+    """Single-validator effective-balance read. An explicit spec method so
+    the resident pipeline (models/phase0/resident.py) can redirect it to
+    device-refreshed mirrors without cloning its callers (proposer
+    rejection sampling)."""
+    return state.validator_registry[index].effective_balance
+
+
 def get_total_balance(spec, state, indices: Sequence[int]) -> int:
     return max(sum(state.validator_registry[i].effective_balance for i in indices), 1)
 
@@ -359,7 +367,7 @@ def _compute_beacon_proposer_index(spec, state) -> int:
     while True:
         candidate_index = first_committee[(epoch + i) % len(first_committee)]
         random_byte = spec.hash(seed + spec.int_to_bytes(i // 32, length=8))[i % 32]
-        effective_balance = state.validator_registry[candidate_index].effective_balance
+        effective_balance = spec.effective_balance_of(state, candidate_index)
         if effective_balance * max_random_byte >= spec.MAX_EFFECTIVE_BALANCE * random_byte:
             return candidate_index
         i += 1
