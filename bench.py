@@ -657,11 +657,13 @@ def main():
     if s2s_res is None:
         raise RuntimeError(f"no stage completed: {device_error}")
     tm, s2s_state = s2s_res
-    s2s_ms = (tm["distill"] + tm["device"] + tm["root"]) * 1e3
-    s2s_txt = ("s2s entry-path %.0f ms = distill %.0f + epoch %.0f + root %.0f, "
-               "writeback %.0f ms excl." % (
-                   s2s_ms, tm["distill"] * 1e3, tm["device"] * 1e3,
-                   tm["root"] * 1e3, tm["writeback"] * 1e3))
+    s2s_ms = (tm["distill"] + tm.get("perm", 0.0) + tm["device"]
+              + tm["root"]) * 1e3
+    s2s_txt = ("s2s entry-path %.0f ms = distill(host) %.0f + perm(dev) %.0f "
+               "+ epoch %.0f + root %.0f, writeback %.0f ms excl." % (
+                   s2s_ms, tm["distill"] * 1e3, tm.get("perm", 0.0) * 1e3,
+                   tm["device"] * 1e3, tm["root"] * 1e3,
+                   tm["writeback"] * 1e3))
     _progress(f"{s2s_txt}; resident multi-epoch drive ({V_STATE} validators)")
     res_epochs = _device(
         "resident", lambda: bench_resident(resumed_state=s2s_state))

@@ -48,7 +48,9 @@ if os.environ.get("CSTPU_ACCEL") == "1":
 # Line-coverage collection (tools/cov.py, stdlib sys.monitoring): opt-in
 # because the artifact write belongs to the CI lane (make citest-cov), not
 # every local run. Near-zero steady overhead (per-location DISABLE).
-if os.environ.get("CSTPU_COV") == "1":
+import sys as _sys
+
+if os.environ.get("CSTPU_COV") == "1" and hasattr(_sys, "monitoring"):
     import importlib.util
     _root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     _cspec = importlib.util.spec_from_file_location(

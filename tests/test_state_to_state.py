@@ -44,7 +44,7 @@ def test_bench_state_to_state_path_matches_object_model():
     dev_cols, _ = process_epoch_soa(spec, state, timings=tm)
     spec.process_epoch(ref)
     assert hash_tree_root(state) == hash_tree_root(ref)
-    assert set(tm) == {"distill", "device", "writeback"}
+    assert set(tm) == {"distill", "perm", "device", "writeback"}
 
     # Device roots from the post-transition columns == recursive oracle
     pk = np.zeros((V, 48), np.uint8)
