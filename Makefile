@@ -10,7 +10,9 @@ JUNIT ?= out/test-results.xml
 
 .PHONY: test testall citest citest-cov citest-mainnet lint vectors vectors-minimal bench bench-cpu multichip smoke clean
 
-COV_FLOOR ?= 80
+# measured 90.64% on the round-5 full suite; floor set just under so real
+# regressions fail while normal drift doesn't
+COV_FLOOR ?= 88
 
 # Default lane: the suite minus the `slow`-marked modules (pairing corpus,
 # state-to-state) — sub-10-minute on the virtual CPU mesh (VERDICT r4 #8).

@@ -539,6 +539,26 @@ def bench_resident(n_epochs: int = 3, resumed_state=None):
                     (state.current_justified_epoch,
                      state.current_justified_root),
                     state.current_epoch_attestations)
+        # checkpoint cycle at full scale: WRITE the resident state to SSZ
+        # bytes (vectorized from columns, no object materialization), then
+        # RESUME a fresh light residency from those bytes — the production
+        # entry path, vs the object-walk entry the s2s stage measures.
+        t0 = time.perf_counter()
+        ckpt = core.checkpoint_bytes()
+        t_write = time.perf_counter() - t0
+        from consensus_specs_tpu.models.phase0.resident import ResidentCore as _RC
+        core2 = None
+        t0 = time.perf_counter()
+        try:
+            core2 = _RC.from_checkpoint(spec, ckpt)
+            core2._registry_balances_roots()   # fence: entry root on device
+            t_resume = time.perf_counter() - t0
+        finally:
+            if core2 is not None:
+                core2._uninstall()
+        results.append({"checkpoint_write": t_write,
+                        "checkpoint_resume": t_resume,
+                        "checkpoint_bytes": len(ckpt)})
     finally:
         # the spec is a cached singleton: residency overrides MUST come off
         # even when a relay loss aborts mid-drive, or every later bench
@@ -669,17 +689,26 @@ def main():
         "resident", lambda: bench_resident(resumed_state=s2s_state))
     resident_ms = None
     res_txt = None
-    if res_epochs is not None and len(res_epochs) >= 2:
+    epochs = [r for r in (res_epochs or []) if "stage" in r]
+    ckpt = next((r for r in (res_epochs or [])
+                 if "checkpoint_write" in r), None)
+    if len(epochs) >= 2:
         # compiles are warm (shared with the s2s stage); the last epoch is
         # the steady state
-        steady = res_epochs[-1]
+        steady = epochs[-1]
         resident_ms = (steady["stage"] + steady["device"]
                        + steady["refresh"]) * 1e3
         res_txt = ("resident per-epoch %.0f ms = stage %.0f + epoch %.0f + "
                    "refresh(root) %.0f over %d epochs; 64 slot-roots %.0f ms" % (
                        resident_ms, steady["stage"] * 1e3,
                        steady["device"] * 1e3, steady["refresh"] * 1e3,
-                       len(res_epochs), steady["slots"] * 1e3))
+                       len(epochs), steady["slots"] * 1e3))
+        if ckpt is not None:
+            res_txt += ("; checkpoint write %.0f ms / resume %.0f ms "
+                        "(%.0f MB, no object materialization)" % (
+                            ckpt["checkpoint_write"] * 1e3,
+                            ckpt["checkpoint_resume"] * 1e3,
+                            ckpt["checkpoint_bytes"] / 1e6))
         _progress(res_txt)
     _progress(f"kernel epoch+shuffle ({V_DEVICE} validators)")
     t_epoch = _device("epoch kernel", bench_epoch_device)

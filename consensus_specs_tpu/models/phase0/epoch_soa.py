@@ -601,7 +601,10 @@ def build_epoch_context(spec, state, np_cols: dict = None) -> EpochContext:
             int(a.data.target_epoch) for a in prev_atts + curr_atts):
         layouts[e] = _epoch_layout(spec, state, np_cols, e)
     ctx = EpochContext(
-        n=len(state.validator_registry), np_cols=np_cols, layouts=layouts,
+        # column length, not len(validator_registry): identical for object
+        # states, and checkpoint-resumed resident states keep the registry
+        # as columns without materializing objects (resident.py)
+        n=len(np_cols["slashed"]), np_cols=np_cols, layouts=layouts,
         prev_atts=prev_atts, curr_atts=curr_atts,
         prev_parts=_decode_participants(spec, layouts, prev_atts),
         curr_parts=_decode_participants(spec, layouts, curr_atts),

@@ -63,6 +63,26 @@ _MIRROR_FIELDS = ("activation_epoch", "exit_epoch", "effective_balance",
 _ALL_FIELDS = ValidatorColumns._fields
 
 
+def light_state_from_bytes(spec, data: bytes):
+    """Serialized BeaconState -> a BeaconState with every field
+    deserialized EXCEPT validator_registry/balances (left empty — in a
+    checkpoint-resumed resident pipeline those live as device columns,
+    and materializing a million Validator objects is the distill floor
+    this path exists to avoid)."""
+    from ...utils.ssz.columns import container_field_spans
+    from ...utils.ssz.impl import deserialize
+
+    spans = container_field_spans(data, spec.BeaconState)
+    state = spec.BeaconState()
+    for name, typ in zip(spec.BeaconState.get_field_names(),
+                         spec.BeaconState.get_field_types()):
+        if name in ("validator_registry", "balances"):
+            continue
+        lo, hi = spans[name]
+        setattr(state, name, deserialize(bytes(data[lo:hi]), typ))
+    return state
+
+
 def _common_path_block(block) -> bool:
     """True when the block touches no registry/balance state on the host
     side (header/randao/eth1/attestations only)."""
@@ -93,26 +113,67 @@ class ResidentCore:
         # tail, not the whole epoch's ~2k attestations. Entries keep a
         # strong ref so an id cannot be recycled while memoized.
         self._att_root_memo: Dict[int, tuple] = {}
+        self._light = False
         self._enter(state)
 
     # -- residency lifecycle ------------------------------------------------
 
-    def _enter(self, state) -> None:
+    @classmethod
+    def from_checkpoint(cls, spec, state_bytes: bytes) -> "ResidentCore":
+        """Resume a serialized BeaconState straight into residency without
+        materializing the registry: the big fields parse as strided-view
+        columns (utils/ssz/columns.py), everything else deserializes into
+        a LIGHT state whose validator_registry/balances stay empty — the
+        device columns are the authority. This is the production resume
+        path (checkpoint bytes in, resident pipeline out); the object-walk
+        entry (`ResidentCore(spec, state)`) exists for states that already
+        live as objects.
+
+        A light-resident core drives slots and epoch boundaries; full
+        block processing and exit() need the object registry and are the
+        standard entry's job."""
+        if spec._insert_after_registry_updates or spec._insert_after_final_updates:
+            raise NotImplementedError(
+                "resident mode covers the phase-0 fused epoch program; "
+                "phase-1 insert hooks take process_epoch_soa_staged")
+        from ...utils.ssz.columns import state_columns_from_bytes
+        np_cols = state_columns_from_bytes(state_bytes, spec)
+        state = light_state_from_bytes(spec, state_bytes)
+        core = cls.__new__(cls)
+        core.spec = spec
+        core.cfg = EpochConfig.from_spec(spec)
+        core.timings = {}
+        core._saved_methods = {}
+        core._saved_root_backend = None
+        core._active_idx_memo = {}
+        core._att_root_memo = {}
+        core._light = True
+        core._enter(state, np_cols=np_cols)
+        return core
+
+    def _enter(self, state, np_cols: Optional[dict] = None) -> None:
         import jax.numpy as jnp
         self.state = state
-        np_cols = columns_np_from_state(state)
+        if np_cols is None:
+            np_cols = dict(columns_np_from_state(state))
+            n = len(state.validator_registry)
+            pk = np.zeros((n, 48), np.uint8)
+            wc = np.zeros((n, 32), np.uint8)
+            for i, v in enumerate(state.validator_registry):
+                pk[i] = np.frombuffer(bytes(v.pubkey), np.uint8)
+                wc[i] = np.frombuffer(bytes(v.withdrawal_credentials), np.uint8)
+            np_cols["pubkey"] = pk
+            np_cols["withdrawal_credentials"] = wc
         self.mirrors: Dict[str, np.ndarray] = {
             f: np_cols[f].copy() for f in _MIRROR_FIELDS}
         self.cols = ValidatorColumns(
             **{f: jnp.asarray(np_cols[f]) for f in _ALL_FIELDS})
-        n = len(state.validator_registry)
-        pk = np.zeros((n, 48), np.uint8)
-        wc = np.zeros((n, 32), np.uint8)
-        for i, v in enumerate(state.validator_registry):
-            pk[i] = np.frombuffer(bytes(v.pubkey), np.uint8)
-            wc[i] = np.frombuffer(bytes(v.withdrawal_credentials), np.uint8)
-        self.pk_dev = jnp.asarray(pk)
-        self.wc_dev = jnp.asarray(wc)
+        # identity columns never change while resident: keep host copies
+        # for the checkpoint WRITE path alongside the device uploads
+        self._pk_np = np.asarray(np_cols["pubkey"])
+        self._wc_np = np.asarray(np_cols["withdrawal_credentials"])
+        self.pk_dev = jnp.asarray(self._pk_np)
+        self.wc_dev = jnp.asarray(self._wc_np)
         self._big_roots: Optional[tuple] = None
         self._active_idx_memo.clear()
         self._install()
@@ -124,6 +185,15 @@ class ResidentCore:
         The spec overrides come off even when the device is gone (a relay
         loss mid-run must not leave the cached spec singleton
         monkey-patched for later host-only stages)."""
+        if self._light:
+            # refuse BEFORE touching the teardown: a refused exit must not
+            # strip the residency overrides as a side effect (a caller that
+            # catches this and keeps driving would otherwise run against
+            # the EMPTY light registry) — use checkpoint_bytes() instead
+            raise NotImplementedError(
+                "a checkpoint-resumed (light) resident state has no object "
+                "registry to materialize into; serialize via "
+                "checkpoint_bytes() instead")
         try:
             new_cols = jax.device_get(self.cols)
             _apply_validator_columns(self.state, new_cols)
@@ -132,6 +202,20 @@ class ResidentCore:
         finally:
             self._uninstall()
         return self.state
+
+    def checkpoint_bytes(self) -> bytes:
+        """Serialize the resident state WITHOUT materializing the registry:
+        the device columns come down once and assemble vectorized into the
+        `List[Validator]`/balances payloads; the small fields serialize
+        from the (light or object) host state. Works in both entry modes;
+        with from_checkpoint this round-trips the original bytes when no
+        transition ran."""
+        from ...utils.ssz.columns import state_bytes_from_columns
+        cols = jax.device_get(self.cols)
+        np_cols = {f: np.asarray(getattr(cols, f)) for f in _ALL_FIELDS}
+        np_cols["pubkey"] = self._pk_np
+        np_cols["withdrawal_credentials"] = self._wc_np
+        return state_bytes_from_columns(self.state, np_cols, self.spec)
 
     def suspended(self):
         """Context manager: temporarily restore the unpatched spec (e.g.

@@ -39,19 +39,17 @@ from .sha256 import bytes_to_words, sha256_single_block
 _MAX_N = 1 << 30
 
 
-@partial(jax.jit, static_argnames=("n", "rounds"))
-def _shuffle_rounds(seed_words: jnp.ndarray, pivots: jnp.ndarray, n: int, rounds: int) -> jnp.ndarray:
-    """seed_words: [8] uint32 (big-endian seed), pivots: [R] int32 (< n).
+def _round_bits(seed_words: jnp.ndarray, n: int, rounds: int,
+                dtype) -> jnp.ndarray:
+    """[rounds, n] per-position decision bits — the consensus-critical
+    digest grammar (seed ‖ round ‖ block_index single-block SHA-256,
+    spec :860-882) in ONE place, shared by both kernel variants.
 
-    Returns perm [n] int32 with perm[p] = image of index p under the shuffle.
-    The [R, B, 16] single-block SHA-256 messages (seed ‖ round ‖ block_index,
-    37 bytes + padding) are assembled on device — the host ships 32 bytes, not
-    megabytes (host↔device bandwidth is the scarce resource, not VPU cycles).
-    """
+    Message layout (big-endian words): w0..w7 = seed; byte32 = round,
+    bytes 33..36 = block index little-endian, byte 37 = 0x80 terminator,
+    w15 = bit length (37*8). All R*B digests come from one batched
+    compression; the host ships 32 bytes, not megabytes."""
     n_blocks = (n + 255) // 256
-    # Message layout (big-endian words): w0..w7 = seed; byte32 = round,
-    # bytes 33..36 = block index little-endian, byte 37 = 0x80 terminator,
-    # w15 = bit length (37*8). Build via broadcasting over [R, B].
     blk = jnp.arange(n_blocks, dtype=jnp.uint32)[None, :]            # [1, B]
     rnd = jnp.arange(rounds, dtype=jnp.uint32)[:, None]              # [R, 1]
     w8 = (rnd << 24) | ((blk & 0xFF) << 16) | (((blk >> 8) & 0xFF) << 8) | ((blk >> 16) & 0xFF)
@@ -62,18 +60,32 @@ def _shuffle_rounds(seed_words: jnp.ndarray, pivots: jnp.ndarray, n: int, rounds
     seed_bcast = [jnp.broadcast_to(seed_words[i], (rounds, n_blocks)) for i in range(8)]
     source_words = jnp.stack(
         seed_bcast + [w8, w9, zeros, zeros, zeros, zeros, zeros, w15], axis=-1)
-    # All R*B source digests in one batched compression: [R, B, 8] uint32.
     digests = sha256_single_block(source_words)
     # Expand to per-position bits [R, n]: byte j of a digest is word j//4,
     # big-endian within the word; bit k of byte j decides position 8j+k.
-    # word w, byte-in-word b (big-endian): byte = w >> (24-8b); bit k: >> k.
     shifts = (24 - 8 * (np.arange(32, dtype=np.uint32) // 8 % 4)  # byte shift
               + np.arange(32, dtype=np.uint32) % 8)               # bit shift
-    # positions within a word: j = 4*word_byte_index... Layout: digest word d
-    # covers bytes 4d..4d+3 -> positions 32d..32d+31 with byte-major order.
     bits = (digests[..., :, None] >> shifts.astype(jnp.uint32)) & jnp.uint32(1)
-    bits = bits.reshape(rounds, n_blocks * 256)[:, :n].astype(jnp.bool_)
+    return bits.reshape(rounds, n_blocks * 256)[:, :n].astype(dtype)
 
+
+def host_pivots(seed: bytes, n: int, rounds: int) -> np.ndarray:
+    """Per-round pivots (64-bit modular reduction of the round hash) —
+    tiny host work where bignum mod is free."""
+    pivots = np.empty(rounds, dtype=np.int32)
+    for r in range(rounds):
+        digest = hashlib.sha256(seed + bytes([r])).digest()
+        pivots[r] = int.from_bytes(digest[:8], "little") % n
+    return pivots
+
+
+@partial(jax.jit, static_argnames=("n", "rounds"))
+def _shuffle_rounds(seed_words: jnp.ndarray, pivots: jnp.ndarray, n: int, rounds: int) -> jnp.ndarray:
+    """seed_words: [8] uint32 (big-endian seed), pivots: [R] int32 (< n).
+
+    Returns perm [n] int32 with perm[p] = image of index p under the shuffle.
+    """
+    bits = _round_bits(seed_words, n, rounds, jnp.bool_)
     pos = jnp.arange(n, dtype=jnp.int32)
     C0 = pos
 
@@ -94,6 +106,33 @@ def _shuffle_rounds(seed_words: jnp.ndarray, pivots: jnp.ndarray, n: int, rounds
     return jax.lax.fori_loop(0, rounds, body, C0)
 
 
+@partial(jax.jit, static_argnames=("n", "rounds"))
+def _shuffle_rounds_stacked(seed_words: jnp.ndarray, pivots: jnp.ndarray,
+                            n: int, rounds: int) -> jnp.ndarray:
+    """A/B variant of _shuffle_rounds: the contents C and the round's
+    decision bits ride ONE [2, n] int32 array, so each round's
+    reverse+roll is a single data movement (one kernel, shared shift)
+    instead of two. Bytes moved rise slightly (bits as int32, not bool);
+    kernel-launch/fusion-boundary count halves. Which effect wins on the
+    Mosaic pipeline is an empirical question — tools/tpu_followup.py A/Bs
+    the two on chip; bit-equality is pinned in tests/test_shuffle_kernel.py.
+    """
+    bits = _round_bits(seed_words, n, rounds, jnp.int32)
+    pos = jnp.arange(n, dtype=jnp.int32)
+
+    def body(k, C):
+        r = rounds - 1 - k
+        pivot = pivots[r]
+        flip = pivot - pos
+        flip = jnp.where(flip < 0, flip + n, flip)
+        X = jnp.stack([C, bits[r]])                    # [2, n]
+        X_flip = jnp.roll(X[:, ::-1], pivot + 1, axis=1)
+        bit_at_max = jnp.where(pos >= flip, X[1], X_flip[1])
+        return jnp.where(bit_at_max == 1, X_flip[0], C)
+
+    return jax.lax.fori_loop(0, rounds, body, pos)
+
+
 def shuffle_permutation_on_device(seed: bytes, index_count: int, rounds: int) -> jnp.ndarray:
     """perm[i] == get_shuffled_index(i, index_count, seed), as a DEVICE array.
 
@@ -103,16 +142,9 @@ def shuffle_permutation_on_device(seed: bytes, index_count: int, rounds: int) ->
     """
     n = int(index_count)
     assert 0 < n < _MAX_N
-
-    # Host: tiny per-round pivot hashes (R scalar sha256 calls; 64-bit
-    # modular reduction is free in Python bignums).
-    pivots = np.empty(rounds, dtype=np.int32)
-    for r in range(rounds):
-        digest = hashlib.sha256(seed + bytes([r])).digest()
-        pivots[r] = int.from_bytes(digest[:8], "little") % n
-
     seed_words = jnp.asarray(bytes_to_words(np.frombuffer(seed, dtype=np.uint8)))
-    return _shuffle_rounds(seed_words, jnp.asarray(pivots), n, rounds)
+    return _shuffle_rounds(seed_words, jnp.asarray(host_pivots(seed, n, rounds)),
+                           n, rounds)
 
 
 def shuffle_permutation_device(seed: bytes, index_count: int, rounds: int) -> np.ndarray:

@@ -86,6 +86,66 @@ def registry_columns_from_bytes(reg_bytes, validator_type: Any
     return cols
 
 
+def registry_bytes_from_columns(np_cols: Dict[str, np.ndarray],
+                                validator_type: Any) -> bytes:
+    """Inverse of registry_columns_from_bytes: SoA columns -> the
+    serialized `List[Validator]` payload, one vectorized record assembly
+    (no per-validator Python)."""
+    layout, stride = fixed_field_layout(validator_type)
+    n = len(np_cols["slashed"])
+    recs = np.zeros((n, stride), dtype=np.uint8)
+    for name, t in zip(validator_type.get_field_names(),
+                       validator_type.get_field_types()):
+        off, size = layout[name]
+        col = np_cols[name]
+        if t is bool:
+            recs[:, off] = np.asarray(col, dtype=np.uint8)
+        elif is_uint_type(t):
+            recs[:, off:off + 8] = np.asarray(col, dtype=np.uint64).astype(
+                "<u8").view(np.uint8).reshape(n, 8)
+        else:
+            recs[:, off:off + size] = col
+    return recs.tobytes()
+
+
+def state_bytes_from_columns(light_state, np_cols: Dict[str, np.ndarray],
+                             spec) -> bytes:
+    """(light state, registry/balances columns) -> serialized BeaconState.
+
+    The checkpoint WRITE path of the resident pipeline: every small field
+    serializes from the light state through the normal encoder, the two
+    registry-scale fields assemble straight from columns — the exact
+    inverse of (light_state_from_bytes, state_columns_from_bytes), so
+    enter->exit round-trips byte-identically (tests/test_resident.py).
+    Offset grammar mirrors impl._encode_series."""
+    from .impl import BYTES_PER_LENGTH_OFFSET, serialize
+
+    typ = spec.BeaconState
+    parts = []
+    for name, t in zip(typ.get_field_names(), typ.get_field_types()):
+        if name == "validator_registry":
+            parts.append((False, registry_bytes_from_columns(
+                np_cols, spec.Validator)))
+        elif name == "balances":
+            parts.append((False, np.asarray(
+                np_cols["balance"], dtype=np.uint64).astype("<u8").tobytes()))
+        else:
+            parts.append((is_fixed_size(t),
+                          serialize(getattr(light_state, name), t)))
+    fixed_len = sum(len(s) if fixed else BYTES_PER_LENGTH_OFFSET
+                    for fixed, s in parts)
+    offset = fixed_len
+    fixed_parts, variable_parts = [], []
+    for fixed, s in parts:
+        if fixed:
+            fixed_parts.append(s)
+        else:
+            fixed_parts.append(offset.to_bytes(BYTES_PER_LENGTH_OFFSET, "little"))
+            variable_parts.append(s)
+            offset += len(s)
+    return b"".join(fixed_parts + variable_parts)
+
+
 def state_columns_from_bytes(state_bytes: bytes, spec) -> Dict[str, np.ndarray]:
     """Serialized `BeaconState` -> the epoch-pipeline column dict
     (same keys/dtypes as `epoch_soa.columns_np_from_state`, plus the

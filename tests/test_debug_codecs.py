@@ -1,0 +1,57 @@
+"""debug/encode <-> debug/decode round trip (the coverage gate caught
+decode.py at 0% — the generator suites only exercise the encode side).
+
+Mirrors the reference pair test_libs/pyspec/eth2spec/debug/{encode,decode}.py:
+any value encode() renders into YAML/JSON-friendly form must decode() back
+to an SSZ-equal value (compared by serialization, the strongest equality
+the type system offers).
+"""
+import pytest
+
+from consensus_specs_tpu.debug.decode import decode
+from consensus_specs_tpu.debug.encode import encode
+from consensus_specs_tpu.debug.random_value import RandomizationMode, get_random_ssz_object
+from consensus_specs_tpu.models import phase0
+from consensus_specs_tpu.utils.ssz.impl import serialize
+from consensus_specs_tpu.utils.ssz.typing import (
+    Bytes32, Bytes96, Container, List, Vector, uint8, uint64)
+
+
+class Inner(Container):
+    a: uint64
+    b: Bytes32
+
+
+class Outer(Container):
+    x: uint8
+    items: List[uint64]
+    fixed: Vector[uint64, 3]
+    inner: Inner
+    sig: Bytes96
+    raw: List[uint8]
+
+
+@pytest.mark.parametrize("mode", [RandomizationMode.RANDOM,
+                                  RandomizationMode.ZERO,
+                                  RandomizationMode.MAX])
+@pytest.mark.parametrize("seed", [0, 7])
+def test_encode_decode_round_trip_synthetic(mode, seed):
+    import random
+    rng = random.Random(seed)
+    obj = get_random_ssz_object(rng, Outer, mode=mode, max_list_length=5)
+    back = decode(encode(obj, Outer), Outer)
+    assert serialize(back, Outer) == serialize(obj, Outer)
+
+
+def test_encode_decode_round_trip_spec_containers():
+    import random
+    spec = phase0.get_spec("minimal")
+    rng = random.Random(42)
+    for name in ("Validator", "AttestationData", "BeaconBlockHeader",
+                 "Crosslink", "Deposit", "Checkpoint" ):
+        typ = getattr(spec, name, None)
+        if typ is None:
+            continue
+        obj = get_random_ssz_object(rng, typ, max_list_length=4)
+        back = decode(encode(obj, typ), typ)
+        assert serialize(back, typ) == serialize(obj, typ), name

@@ -54,3 +54,22 @@ def test_backend_hook_used_and_cached():
     finally:
         helpers.set_shuffle_backend(None)
         spec.clear_caches()
+
+
+@pytest.mark.parametrize("n", [1, 7, 256, 1000, 2048])
+def test_stacked_variant_bit_equal(n):
+    """The [2, n] stacked-movement A/B variant == the reference kernel
+    (tools/tpu_followup.py picks between them on chip by timing)."""
+    import jax.numpy as jnp
+
+    from consensus_specs_tpu.ops.shuffle import (
+        _shuffle_rounds_stacked, host_pivots, shuffle_permutation_on_device)
+    from consensus_specs_tpu.ops.sha256 import bytes_to_words
+
+    seed = hashlib.sha256(b"stacked shuffle").digest()
+    rounds = 90
+    base = np.asarray(shuffle_permutation_on_device(seed, n, rounds))
+    seed_words = jnp.asarray(bytes_to_words(np.frombuffer(seed, dtype=np.uint8)))
+    stacked = np.asarray(_shuffle_rounds_stacked(
+        seed_words, jnp.asarray(host_pivots(seed, n, rounds)), n, rounds))
+    assert np.array_equal(base, stacked)

@@ -151,6 +151,27 @@ def main():
           f"of HBM peak; bandwidth-bound floor {hi_gb/HBM_PEAK*1e3:.1f} ms",
           flush=True)
 
+    # A/B: the stacked-movement variant (one [2, n] reverse+roll per round
+    # instead of two; bit-equality pinned in tests/test_shuffle_kernel.py)
+    from consensus_specs_tpu.ops.sha256 import bytes_to_words
+    from consensus_specs_tpu.ops.shuffle import (_shuffle_rounds_stacked,
+                                                 host_pivots)
+    sd = bytes(range(32))
+    sw = jnp.asarray(bytes_to_words(np.frombuffer(sd, dtype=np.uint8)))
+    pv = jnp.asarray(host_pivots(sd, Vr, R))
+    ps = _shuffle_rounds_stacked(sw, pv, Vr, R)
+    assert np.array_equal(np.asarray(ps), np.asarray(perm)), \
+        "stacked shuffle != reference kernel on TPU"
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(_shuffle_rounds_stacked(sw, pv, Vr, R).ravel()[0:1])
+        ts.append(time.perf_counter() - t0)
+    t_stk = max(min(ts) - rtt, 1e-9)
+    print(f"[roofline] shuffle stacked variant: {t_stk*1e3:.1f} ms "
+          f"({t_shuf/t_stk:.2f}x vs reference kernel) — adopt via "
+          f"install_device_shuffler if it wins", flush=True)
+
     from consensus_specs_tpu.utils.ssz import bulk as _bulk
     rng_r = np.random.default_rng(3)
     cols_r = [
