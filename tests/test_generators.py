@@ -125,3 +125,13 @@ def test_ssz_static_phase1_covers_extended_containers():
     assert suite.handler == "core_phase1" and suite.forks == ["phase1"]
     for c in suite.test_cases[:10]:
         assert c["serialized"].startswith("0x") and len(c["root"]) == 66
+
+
+def test_cli_module_main(tmp_path):
+    """The `python -m consensus_specs_tpu.generators` entry point (family
+    selection + arg passthrough) — the piece `make vectors` runs."""
+    from consensus_specs_tpu.generators.__main__ import main
+    out = tmp_path / "v"
+    main(["-o", str(out), "-p", "minimal", "--family", "shuffling"])
+    files = list(out.rglob("*.yaml"))
+    assert files, "shuffling family must emit at least one suite file"

@@ -122,3 +122,40 @@ def test_sharded_output_stays_sharded(mesh):
     jax.block_until_ready(out_cols)
     shard_v = NamedSharding(mesh, P("v"))
     assert out_cols.balance.sharding.is_equivalent_to(shard_v, out_cols.balance.ndim)
+
+
+def test_hierarchical_mesh_epoch_equals_single():
+    """Multi-host shape: 8 virtual devices arranged as 2 hosts x 4 ICI
+    devices (the DCN-outer/ICI-inner mesh of parallel/sharding.py). The
+    epoch program over the flattened ("host", "v") sharding must stay
+    bit-equal to single-device — the multi-host counterpart of the
+    NCCL/MPI backend, expressed as placement."""
+    import jax.numpy as jnp
+
+    from consensus_specs_tpu.parallel.sharding import (
+        hierarchical_mesh, shard_hierarchical)
+    if len(jax.devices()) < N_DEV:
+        pytest.skip(f"needs {N_DEV} devices")
+    hmesh = hierarchical_mesh(jax.devices()[:N_DEV], hosts=2)
+    assert hmesh.devices.shape == (2, 4)
+
+    spec = phase0.get_spec("minimal")
+    cfg = EpochConfig.from_spec(spec)
+    cols, scal, inp = synthetic_epoch_state(
+        cfg, 64 * N_DEV, np.random.default_rng(9), random_eligibility=True)
+    single = jax.device_get(epoch_transition_device(cfg, cols, scal, inp))
+    cols_s = shard_hierarchical(hmesh, cols)
+    scal_s = shard_hierarchical(hmesh, scal)  # 0-d scalars replicate
+    # per-shard tables replicate; [V] facts shard with the columns
+    import jax as _jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    repl = NamedSharding(hmesh, PartitionSpec())
+    inp_s = inp._replace(
+        shard_att_balance=_jax.device_put(inp.shard_att_balance, repl),
+        shard_comm_balance=_jax.device_put(inp.shard_comm_balance, repl))
+    inp_s = inp_s._replace(**{
+        f: _jax.device_put(getattr(inp, f),
+                           NamedSharding(hmesh, PartitionSpec(("host", "v"))))
+        for f in inp._fields[:-2]})
+    sharded = jax.device_get(epoch_transition_device(cfg, cols_s, scal_s, inp_s))
+    assert trees_bitwise_equal(single, sharded)

@@ -145,3 +145,16 @@ def test_checkpoint_resume_light_residency(spec):
     light = light_state_from_bytes(spec, data)
     assert len(light.validator_registry) == 0 and len(light.balances) == 0
     assert int(light.slot) == int(state.slot)
+
+
+def test_from_checkpoint_rejects_phase1_hooks(spec):
+    """A phase-1 spec (epoch insert hooks) must refuse BOTH entry points —
+    the staged path (process_epoch_soa_staged) owns that configuration."""
+    from consensus_specs_tpu.models import phase1
+    p1 = phase1.get_spec("minimal")
+    state = factories.seed_genesis_state(p1, 8)
+    data = serialize(state, p1.BeaconState)
+    with pytest.raises(NotImplementedError):
+        ResidentCore.from_checkpoint(p1, data)
+    with pytest.raises(NotImplementedError):
+        ResidentCore(p1, state)

@@ -29,6 +29,10 @@ def main():
     import os
 
     import jax
+    # CPU smoke mode for the harness itself (the config API is the only
+    # reliable pin once the site hook pre-imported jax — see bench.py)
+    if os.environ.get("CSTPU_FOLLOWUP_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
     # share bench.py's persistent compile cache: the pairing/Merkle programs
     # take minutes to compile fresh on the chip; a timed-out attempt's
     # compiles still carry over to the next retry through the disk cache
@@ -79,29 +83,39 @@ def main():
     hash_to_g2_batch([(bytes([m]) * 32, 2) for m in range(8)])
     print(f"hash_to_g2 batch8 steady: {time.time()-t0:.2f}s", flush=True)
 
-    # 4) unrolled == fori sha256 on chip
+    # Sections 4/4b need the real Mosaic pipeline: the unrolled SHA form
+    # trips XLA:CPU's algebraic-simplifier rewrite loop (ops/sha256.py) and
+    # the compiled Pallas lowering exists only for TPU. Gating them on the
+    # device platform lets the REST of this pass smoke-test on CPU, so a
+    # Python-level bug here can't waste a rare relay window.
+    on_tpu = jax.devices()[0].platform == "tpu"
     import jax.numpy as jnp
     from consensus_specs_tpu.ops.sha256 import sha256_pairs
     rng = np.random.default_rng(5)
     words = jnp.asarray(rng.integers(0, 2 ** 32, (8192, 16), dtype=np.uint32))
-    a = np.asarray(sha256_pairs(words, unroll=True))
-    b = np.asarray(sha256_pairs(words, unroll=False))
-    assert (a == b).all(), "unrolled != fori on TPU"
-    print("sha256 unrolled == fori on chip", flush=True)
+    if on_tpu:
+        # 4) unrolled == fori sha256 on chip
+        a = np.asarray(sha256_pairs(words, unroll=True))
+        b = np.asarray(sha256_pairs(words, unroll=False))
+        assert (a == b).all(), "unrolled != fori on TPU"
+        print("sha256 unrolled == fori on chip", flush=True)
 
-    # 4b) Pallas (Mosaic) pair-hash vs XLA kernel on chip + A/B timing
-    from consensus_specs_tpu.ops.sha256_pallas import sha256_pairs_pallas
-    t0 = time.time()
-    p = np.asarray(sha256_pairs_pallas(words, interpret=False))
-    print(f"pallas pair-hash first: {time.time()-t0:.1f}s", flush=True)
-    assert (p == a).all(), "pallas != XLA pair-hash on TPU"
-    for label, fn in (("pallas", lambda: sha256_pairs_pallas(words, interpret=False)),
-                      ("xla", lambda: sha256_pairs(words, unroll=True))):
+        # 4b) Pallas (Mosaic) pair-hash vs XLA kernel on chip + A/B timing
+        from consensus_specs_tpu.ops.sha256_pallas import sha256_pairs_pallas
         t0 = time.time()
-        for _ in range(3):
-            np.asarray(fn())
-        print(f"sha256 pair-hash {label} steady: {(time.time()-t0)/3*1e3:.1f} ms",
-              flush=True)
+        p = np.asarray(sha256_pairs_pallas(words, interpret=False))
+        print(f"pallas pair-hash first: {time.time()-t0:.1f}s", flush=True)
+        assert (p == a).all(), "pallas != XLA pair-hash on TPU"
+        for label, fn in (("pallas", lambda: sha256_pairs_pallas(words, interpret=False)),
+                          ("xla", lambda: sha256_pairs(words, unroll=True))):
+            t0 = time.time()
+            for _ in range(3):
+                np.asarray(fn())
+            print(f"sha256 pair-hash {label} steady: {(time.time()-t0)/3*1e3:.1f} ms",
+                  flush=True)
+    else:
+        print("[skip] unrolled-SHA + Pallas A/B (TPU-only lowering; "
+              "CPU smoke mode)", flush=True)
 
     # 4c) roofline accounting (VERDICT r4 #4): per kernel, the modeled
     #     bytes/ops, the measured wall-clock, and the implied fraction of
@@ -144,11 +158,12 @@ def main():
     # ~9 B/elem/round (read C+bits, write C)
     hi_gb = 34e-9 * Vr * R
     lo_gb = 9e-9 * Vr * R
+    hbm_gbs = HBM_PEAK / 1e9   # peak in GB/s (traffic model is in GB)
     print(f"[roofline] shuffle 1M x {R} rounds: {t_shuf*1e3:.1f} ms "
           f"(fence-corrected) | traffic model {lo_gb:.1f}-{hi_gb:.1f} GB -> "
           f"{lo_gb/t_shuf:.0f}-{hi_gb/t_shuf:.0f} GB/s = "
-          f"{100*lo_gb/t_shuf/HBM_PEAK:.1f}-{100*hi_gb/t_shuf/HBM_PEAK:.1f}% "
-          f"of HBM peak; bandwidth-bound floor {hi_gb/HBM_PEAK*1e3:.1f} ms",
+          f"{100*lo_gb/t_shuf/hbm_gbs:.1f}-{100*hi_gb/t_shuf/hbm_gbs:.1f}% "
+          f"of HBM peak; bandwidth-bound floor {hi_gb/hbm_gbs*1e3:.1f} ms",
           flush=True)
 
     # A/B: the stacked-movement variant (one [2, n] reverse+roll per round

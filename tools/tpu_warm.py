@@ -78,7 +78,7 @@ def main(ladder):
         dg2 = jnp.asarray(g2_all[:G])
         jax.block_until_ready((dg1, dg2))
         _say(f"G={G}: compiling + running grouped pairing "
-             f"({3 * G} Miller loops + batched final exp)")
+             f"({G} shared-squaring 3-pair products + batched final exp)")
         t0 = time.time()
         ok = np.asarray(grouped_pairing_check(dg1, dg2))
         t_first = time.time() - t0
