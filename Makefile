@@ -8,7 +8,7 @@ PYTHON ?= python
 VECTOR_DIR ?= out/vectors
 JUNIT ?= out/test-results.xml
 
-.PHONY: test testall citest citest-cov citest-mainnet lint vectors vectors-minimal bench bench-cpu multichip smoke clean
+.PHONY: test testall citest citest-cov citest-mainnet lint analyze vectors vectors-minimal bench bench-cpu multichip smoke clean
 
 # measured 90.64% on the round-5 full suite; floor set just under so real
 # regressions fail while normal drift doesn't
@@ -46,7 +46,16 @@ citest-mainnet:
 
 # Syntax + style gate (see tools/lint.py; no third-party linters in image).
 lint:
-	$(PYTHON) tools/lint.py consensus_specs_tpu tests bench.py __graft_entry__.py
+	$(PYTHON) tools/lint.py consensus_specs_tpu tests bench.py __graft_entry__.py tools
+
+# Trace-safety / spec-conformance static analysis (tools/analysis/README.md):
+# five AST passes over the jit surface — Python control flow on tracers,
+# 32-bit truncation of uint64 math, impure traced code, state-aliasing
+# overrides, jit-cache hygiene. Exit 0 = no findings beyond the committed
+# baseline + inline `# csa: ignore[...]` suppressions.
+analyze:
+	$(PYTHON) -m tools.analysis consensus_specs_tpu bench.py __graft_entry__.py \
+		--baseline tools/analysis/baseline.json --json out/analysis.json
 
 # Conformance vectors, both presets (reference: make gen_yaml_tests).
 vectors:
@@ -70,9 +79,11 @@ bench-cpu:
 multichip:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-# Quick health check: lint + the fast test modules.
+# Quick health check: lint + static analysis + the fast test modules.
 smoke:
-	$(PYTHON) tools/lint.py consensus_specs_tpu tests bench.py __graft_entry__.py
+	$(PYTHON) tools/lint.py consensus_specs_tpu tests bench.py __graft_entry__.py tools
+	$(PYTHON) -m tools.analysis consensus_specs_tpu bench.py __graft_entry__.py \
+		--baseline tools/analysis/baseline.json
 	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py -q
 
 clean:

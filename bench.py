@@ -482,6 +482,9 @@ def bench_resident(n_epochs: int = 3, resumed_state=None):
                 crosslink=spec.Crosslink(
                     shard=shard,
                     parent_root=spec.hash_tree_root(state.current_crosslinks[shard]),
+                    # canonical chains extend the parent: the vote's span
+                    # starts where the current crosslink ended
+                    start_epoch=state.current_crosslinks[shard].end_epoch,
                     end_epoch=min(target_epoch, state.current_crosslinks[shard].end_epoch
                                   + spec.MAX_EPOCHS_PER_CROSSLINK),
                 ),

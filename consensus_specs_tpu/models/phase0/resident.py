@@ -241,8 +241,17 @@ class ResidentCore:
 
     def _install(self) -> None:
         spec, mirrors = self.spec, self.mirrors
+        saved = self._saved_methods
+
+        # The mirrors describe self.state ONLY — mirror the _state_root
+        # guard in every override that receives a state: any other state
+        # (fork choice's justified state, a differential reference copy)
+        # delegates to the saved object-path original instead of silently
+        # answering from the resident columns.
 
         def get_active_validator_indices(state, epoch):
+            if state is not self.state:
+                return saved["get_active_validator_indices"](state, epoch)
             memo = self._active_idx_memo.get(int(epoch))
             if memo is None:
                 e = np.uint64(int(epoch))
@@ -254,17 +263,23 @@ class ResidentCore:
             return memo
 
         def compute_committee(indices, seed, index, count):
+            # state-free by signature: fully determined by the caller's
+            # indices/seed, so no aliasing guard is possible or needed
             n = len(indices)
             start, end = (n * index) // count, (n * (index + 1)) // count
             perm = spec.get_shuffle_permutation(n, seed)
             return np.asarray(indices)[perm[start:end]].tolist()
 
         def get_total_balance(state, indices):
+            if state is not self.state:
+                return saved["get_total_balance"](state, indices)
             # callers pass lists, sets, or arrays
             idx = np.fromiter(indices, dtype=np.int64)
             return max(int(mirrors["effective_balance"][idx].sum()), 1)
 
         def effective_balance_of(state, index):
+            if state is not self.state:
+                return saved["effective_balance_of"](state, index)
             return int(mirrors["effective_balance"][index])
 
         # Proposer sampling and final updates need no clones: the shared
@@ -355,6 +370,14 @@ class ResidentCore:
     # -- transition drive ---------------------------------------------------
 
     def state_transition(self, state, block):
+        if self._light:
+            # fail loudly BEFORE process_slots mutates state (matching the
+            # exit() guard): block processing reads the object registry,
+            # which a checkpoint-resumed core deliberately never built
+            raise NotImplementedError(
+                "a checkpoint-resumed (light) resident core drives slots "
+                "and epoch boundaries only; blocks need the object "
+                "registry — resume via the standard ResidentCore entry")
         self.process_slots(state, block.slot)
         if _common_path_block(block):
             self.spec.process_block(state, block)

@@ -180,7 +180,9 @@ def _g2_decompress_traced(x_raw, a_flag):
     from ..crypto import bls12_381 as gt
     from . import fq_tower as T
 
-    global _SQRT2_EXP_BITS
+    # deliberate: idempotent trace-time memo of a pure host constant
+    # (same value every trace), read only as a compile-time unroll bound
+    global _SQRT2_EXP_BITS  # csa: ignore[CSA302]
     if _SQRT2_EXP_BITS is None:
         _SQRT2_EXP_BITS = F._exp_bits((gt.q ** 2 + 7) // 16)
     even_roots, fourth_inv, g2_b = _g2_constants()

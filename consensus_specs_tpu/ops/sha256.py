@@ -21,7 +21,7 @@ Host bridging helpers convert bytes <-> big-endian uint32 word arrays.
 """
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -69,7 +69,7 @@ def _unroll_for(lanes: int) -> bool:
 
 
 def sha256_blocks(state: jnp.ndarray, block: jnp.ndarray,
-                  unroll=None) -> jnp.ndarray:
+                  unroll: Optional[bool] = None) -> jnp.ndarray:
     """One SHA-256 compression. state: [..., 8] uint32, block: [..., 16] uint32.
 
     unroll=True statically unrolls the 64 rounds with a rotating 16-word

@@ -19,7 +19,13 @@ import jax  # noqa: E402
 
 if os.environ.get("CSTPU_TEST_TPU") != "1":
     jax.config.update("jax_platforms", "cpu")  # suspenders: post-import pin
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # pre-0.5 jax has no such option; XLA reads XLA_FLAGS lazily at
+        # backend init, so setting it here (pre-init) still yields 8 devices
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
 
 # Persistent compilation cache: the BLS pairing programs take ~1 min each to
 # compile on the CPU backend; caching them across pytest processes turns

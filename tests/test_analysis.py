@@ -1,0 +1,446 @@
+"""tools/analysis — fixture snippets per rule (positive, negative,
+suppressed), the baseline ratchet, the CLI contract, and the repo-wide
+green guarantee `make analyze` enforces.
+
+Runs in the default (not slow) lane: pure AST work, no jax imports by the
+analyzer itself.
+"""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.analysis import analyze_paths, load_baseline
+from tools.analysis.core import RULES, write_baseline
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def findings_for(tmp_path, source, name="snippet.py"):
+    path = tmp_path / name
+    path.write_text(source)
+    return analyze_paths([str(path)]).findings
+
+
+def rule_ids(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# CSA1xx trace-safety
+# ---------------------------------------------------------------------------
+
+def test_trace_safety_flags_control_flow_and_casts(tmp_path):
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        x = x + 1\n"
+        "    while x < 3:\n"
+        "        x = x * 2\n"
+        "    y = jnp.sum(x)\n"
+        "    return int(y)\n"
+    )
+    got = rule_ids(findings_for(tmp_path, src))
+    assert got == ["CSA101", "CSA101", "CSA102"]
+
+
+def test_trace_safety_scans_transitive_callees(tmp_path):
+    # the jitted fn is clean; the plain helper it calls is not
+    src = (
+        "import jax\n"
+        "def helper(y):\n"
+        "    return bool(y)\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return helper(x)\n"
+    )
+    found = findings_for(tmp_path, src)
+    assert rule_ids(found) == ["CSA102"]
+    assert found[0].context == "helper"
+
+
+def test_trace_safety_negative_static_and_shape(tmp_path):
+    # static args, shape reads, and host-annotated callee params are not
+    # tracers; partial(jax.jit, static_argnums) form must be understood
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from functools import partial\n"
+        "def pick(n: int):\n"
+        "    if n > 2:\n"
+        "        return 1\n"
+        "    return 0\n"
+        "@partial(jax.jit, static_argnums=(0,))\n"
+        "def f(cfg, x):\n"
+        "    if cfg.wide:\n"
+        "        x = x + 1\n"
+        "    n = x.shape[0]\n"
+        "    if n > 2:\n"
+        "        x = x * 2\n"
+        "    return x + pick(int(n))\n"
+    )
+    assert findings_for(tmp_path, src) == []
+
+
+def test_trace_safety_jit_factory_form(tmp_path):
+    # a def passed by name into a jit-memoizing factory (the
+    # utils/ssz/bulk.py `_get_root_jit(name, fn)` shape) is jit context
+    src = (
+        "import jax\n"
+        "_memo = {}\n"
+        "def get_jit(name, fn):\n"
+        "    if name not in _memo:\n"
+        "        _memo[name] = jax.jit(fn)\n"
+        "    return _memo[name]\n"
+        "def root(x):\n"
+        "    return int(x)\n"
+        "def driver(x):\n"
+        "    return get_jit('root', root)(x)\n"
+    )
+    found = findings_for(tmp_path, src)
+    assert rule_ids(found) == ["CSA102"]
+    assert found[0].context == "root"
+
+
+def test_trace_safety_wrapper_assignment_form(tmp_path):
+    # name = jax.jit(fn): fn is jit context even without a decorator
+    src = (
+        "import jax\n"
+        "def g(x):\n"
+        "    return x.item()\n"
+        "g_jit = jax.jit(g)\n"
+    )
+    assert rule_ids(findings_for(tmp_path, src)) == ["CSA102"]
+
+
+# ---------------------------------------------------------------------------
+# CSA2xx dtype-width
+# ---------------------------------------------------------------------------
+
+def test_dtype_width_flags_defaulting_ctor_and_wide_literal(tmp_path):
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(v):\n"
+        "    z = jnp.zeros(4)\n"
+        "    return z + v * 2 ** 40\n"
+    )
+    assert rule_ids(findings_for(tmp_path, src)) == ["CSA201", "CSA202"]
+
+
+def test_dtype_width_negative_explicit_dtype(tmp_path):
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(v):\n"
+        "    z = jnp.zeros(4, dtype=jnp.uint64)\n"
+        "    w = jnp.asarray(v)\n"          # copy ctor keeps dtype: fine
+        "    return z + w * jnp.uint64(2 ** 40)\n"
+    )
+    assert findings_for(tmp_path, src) == []
+
+
+# ---------------------------------------------------------------------------
+# CSA3xx purity
+# ---------------------------------------------------------------------------
+
+def test_purity_flags_time_random_global_and_mutation(tmp_path):
+    src = (
+        "import jax, time, random\n"
+        "import numpy as np\n"
+        "COUNTER = 0\n"
+        "@jax.jit\n"
+        "def f(x, out):\n"
+        "    global COUNTER\n"
+        "    t = time.time()\n"
+        "    r = random.random()\n"
+        "    s = np.random.rand()\n"
+        "    out[0] = t + r + s\n"
+        "    return x\n"
+    )
+    got = rule_ids(findings_for(tmp_path, src))
+    assert got == ["CSA301", "CSA301", "CSA301", "CSA302", "CSA303"]
+
+
+def test_purity_negative_host_code_untouched(tmp_path):
+    # the same calls OUTSIDE jit context are host code, perfectly legal
+    src = (
+        "import time\n"
+        "def bench():\n"
+        "    t0 = time.perf_counter()\n"
+        "    return time.perf_counter() - t0\n"
+    )
+    assert findings_for(tmp_path, src) == []
+
+
+# ---------------------------------------------------------------------------
+# CSA401 state-aliasing
+# ---------------------------------------------------------------------------
+
+PRE_FIX_RESIDENT_SNIPPET = (
+    # the exact shape of the pre-fix resident.py _install overrides: a
+    # `state`-accepting closure answering from captured mirrors
+    "import numpy as np\n"
+    "class ResidentCore:\n"
+    "    def _install(self):\n"
+    "        mirrors = self.mirrors\n"
+    "        def get_total_balance(state, indices):\n"
+    "            idx = np.fromiter(indices, dtype=np.int64)\n"
+    "            return max(int(mirrors['effective_balance'][idx].sum()), 1)\n"
+    "        def effective_balance_of(state, index):\n"
+    "            return int(mirrors['effective_balance'][index])\n"
+    "        return get_total_balance, effective_balance_of\n"
+)
+
+
+def test_state_aliasing_flags_pre_fix_resident_pattern(tmp_path):
+    found = findings_for(tmp_path, PRE_FIX_RESIDENT_SNIPPET)
+    assert rule_ids(found) == ["CSA401", "CSA401"]
+    # context is scope-qualified so same-named closures elsewhere in the
+    # file can't share a fingerprint
+    assert {f.context for f in found} == \
+        {"ResidentCore._install.get_total_balance",
+         "ResidentCore._install.effective_balance_of"}
+
+
+def test_state_aliasing_same_named_closures_get_distinct_fingerprints(
+        tmp_path):
+    src = (
+        "class A:\n"
+        "    def make(self):\n"
+        "        def handler(state, x):\n"
+        "            return x\n"
+        "        return handler\n"
+        "class B:\n"
+        "    def make(self):\n"
+        "        def handler(state, x):\n"
+        "            return x + 1\n"
+        "        return handler\n"
+    )
+    found = findings_for(tmp_path, src)
+    assert rule_ids(found) == ["CSA401", "CSA401"]
+    fps = {f.fingerprint() for f in found}
+    assert len(fps) == 2   # baselining one must not hide the other
+
+
+def test_state_aliasing_negative_guarded_override(tmp_path):
+    # the post-fix shape: delegating on `state is not self.state` reads
+    # the parameter, so the aliasing hazard is structurally gone
+    src = (
+        "class Core:\n"
+        "    def _install(self, saved):\n"
+        "        def effective_balance_of(state, index):\n"
+        "            if state is not self.state:\n"
+        "                return saved(state, index)\n"
+        "            return int(self.mirrors['effective_balance'][index])\n"
+        "        return effective_balance_of\n"
+    )
+    assert findings_for(tmp_path, src) == []
+
+
+def test_state_aliasing_skips_stubs_and_honors_suppression(tmp_path):
+    src = (
+        "def abstract_handler(state, msg):\n"
+        "    raise NotImplementedError\n"
+        "# csa: ignore[CSA401]\n"
+        "def interface_conformance(state, x):\n"
+        "    return x\n"
+    )
+    path = tmp_path / "s.py"
+    path.write_text(src)
+    report = analyze_paths([str(path)])
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+    assert report.suppressed[0].rule == "CSA401"
+
+
+# ---------------------------------------------------------------------------
+# CSA5xx jit-cache hygiene
+# ---------------------------------------------------------------------------
+
+def test_jit_cache_flags_scalar_call_and_unhashable_static(tmp_path):
+    src = (
+        "import jax\n"
+        "from functools import partial\n"
+        "def f(n, x):\n"
+        "    return x\n"
+        "f_jit = jax.jit(f)\n"
+        "@partial(jax.jit, static_argnums=(0,))\n"
+        "def g(table: list, x):\n"
+        "    return x\n"
+        "def driver(x):\n"
+        "    return f_jit(3, x)\n"
+    )
+    assert rule_ids(findings_for(tmp_path, src)) == ["CSA501", "CSA502"]
+
+
+def test_jit_cache_ignores_same_named_attribute_calls(tmp_path):
+    # store.update(...) is some other object's method, not the module's
+    # jitted `update` — no CSA501
+    src = (
+        "import jax\n"
+        "def _update(n, x):\n"
+        "    return x\n"
+        "update = jax.jit(_update)\n"
+        "def driver(store, x):\n"
+        "    store.update(3, x)\n"
+        "    return update(x, x)\n"
+    )
+    assert findings_for(tmp_path, src) == []
+
+
+def test_trace_safety_walrus_taint(tmp_path):
+    # NamedExpr binds like an Assign: both the `if` test containing the
+    # walrus and later host casts of its target are traced hazards
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if (s := jnp.sum(x)) > 0:\n"
+        "        return int(s)\n"
+        "    return s\n"
+    )
+    assert rule_ids(findings_for(tmp_path, src)) == ["CSA101", "CSA102"]
+
+
+def test_jit_cache_negative_static_scalar_ok(tmp_path):
+    # a scalar into a STATIC slot is the intended use; arrays into traced
+    # slots are fine too
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(0,))\n"
+        "def f(n: int, x):\n"
+        "    return x * n\n"
+        "def driver(x):\n"
+        "    return f(3, jnp.asarray(x))\n"
+    )
+    assert findings_for(tmp_path, src) == []
+
+
+# ---------------------------------------------------------------------------
+# framework: baseline ratchet + CLI + repo green
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip_and_stale_detection(tmp_path):
+    path = tmp_path / "s.py"
+    path.write_text(PRE_FIX_RESIDENT_SNIPPET)
+    report = analyze_paths([str(path)])
+    assert len(report.findings) == 2
+
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), report.findings)
+    baseline = load_baseline(str(bl_path))
+    ratcheted = analyze_paths([str(path)], baseline)
+    assert ratcheted.findings == []
+    assert len(ratcheted.baselined) == 2
+    assert ratcheted.stale_baseline == []
+
+    # fix one of the two: its baseline entry goes stale, run stays green
+    path.write_text(PRE_FIX_RESIDENT_SNIPPET.replace(
+        "return int(mirrors['effective_balance'][index])",
+        "return int(state.validator_registry[index].effective_balance)"))
+    after_fix = analyze_paths([str(path)], baseline)
+    assert after_fix.findings == []
+    assert len(after_fix.stale_baseline) == 1
+
+
+def test_update_baseline_preserves_live_entries_and_reasons(tmp_path):
+    """Refreshing the baseline must keep still-live entries (with their
+    hand-written reasons), not reset the file to just-new findings."""
+    path = tmp_path / "s.py"
+    path.write_text(PRE_FIX_RESIDENT_SNIPPET)
+    first = analyze_paths([str(path)])
+    live_fp = first.findings[0].fingerprint()
+
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), [first.findings[0]])
+    # hand-edit the reason, as the README instructs
+    data = json.loads(bl_path.read_text())
+    data["entries"][0]["reason"] = "deliberate: documented at the site"
+    bl_path.write_text(json.dumps(data))
+
+    baseline = load_baseline(str(bl_path))
+    report = analyze_paths([str(path)], baseline)
+    assert len(report.findings) == 1 and len(report.baselined) == 1
+    # the --update-baseline merge: actionable + still-baselined, reasons
+    # carried over for entries that were already in the file
+    write_baseline(str(bl_path), report.findings + report.baselined,
+                   prior=baseline)
+    merged = json.loads(bl_path.read_text())["entries"]
+    assert len(merged) == 2
+    by_fp = {e["fingerprint"]: e["reason"] for e in merged}
+    assert by_fp[live_fp] == "deliberate: documented at the site"
+    refreshed = analyze_paths([str(path)], load_baseline(str(bl_path)))
+    assert refreshed.findings == [] and refreshed.stale_baseline == []
+
+
+def _run_cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.analysis", *args],
+        cwd=cwd, capture_output=True, text=True)
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(PRE_FIX_RESIDENT_SNIPPET)
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f(state):\n    return state.slot\n")
+    out_json = tmp_path / "analysis.json"
+
+    proc = _run_cli([str(dirty), "--json", str(out_json)])
+    assert proc.returncode == 1
+    assert "CSA401" in proc.stdout
+    data = json.loads(out_json.read_text())
+    assert [f["rule"] for f in data["findings"]] == ["CSA401", "CSA401"]
+
+    assert _run_cli([str(clean)]).returncode == 0
+    assert _run_cli(["--list-rules"]).returncode == 0
+
+
+@pytest.mark.parametrize("rule_class,snippet", [
+    ("CSA101", "import jax\n@jax.jit\ndef f(x):\n    if x > 0:\n"
+               "        return x\n    return -x\n"),
+    ("CSA201", "import jax\nimport jax.numpy as jnp\n@jax.jit\n"
+               "def f(x):\n    return x + jnp.zeros(3)\n"),
+    ("CSA301", "import jax, time\n@jax.jit\ndef f(x):\n"
+               "    return x + time.time()\n"),
+    ("CSA401", "def f(state):\n    return 1\n"),
+    ("CSA501", "import jax\ndef f(x):\n    return x\n"
+               "f_jit = jax.jit(f)\ny = f_jit(3)\n"),
+])
+def test_cli_nonzero_per_rule_class(tmp_path, rule_class, snippet):
+    """Acceptance: injected fixtures for each of the 5 rule classes exit
+    non-zero through the real CLI."""
+    path = tmp_path / "inject.py"
+    path.write_text(snippet)
+    proc = _run_cli([str(path)])
+    assert proc.returncode == 1
+    assert rule_class in proc.stdout
+
+
+def test_repo_is_analysis_clean():
+    """The `make analyze` guarantee, asserted in-process: the shipped tree
+    has no actionable findings over the committed baseline."""
+    baseline = load_baseline(str(REPO / "tools" / "analysis" / "baseline.json"))
+    report = analyze_paths(
+        [str(REPO / "consensus_specs_tpu"), str(REPO / "bench.py"),
+         str(REPO / "__graft_entry__.py")], baseline)
+    assert report.findings == []
+    assert report.stale_baseline == []
+
+
+def test_rule_catalog_documented():
+    """Every registered rule appears in tools/analysis/README.md."""
+    readme = (REPO / "tools" / "analysis" / "README.md").read_text()
+    for rule_id in RULES:
+        assert rule_id in readme, f"{rule_id} missing from README"

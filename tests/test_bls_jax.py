@@ -308,30 +308,28 @@ def test_grouped_miller_matches_pairwise_product():
     import jax.numpy as jnp
     Ps = [rand_g1() for _ in range(4)]
     Qs = [rand_g2() for _ in range(4)]
-    # group 0: e(2P0,Q0)*e(-P0,2Q0) [passes] padded with a passing pair;
-    # group 1: three slots that do NOT cancel [fails]
+    # group 0: e(2P0,Q0)*e(-P0,2Q0)=1 times a stray e(P1,Q1) [fails];
+    # group 1: three slots that do NOT cancel [fails];
+    # group 2: e(P0,Q0)*e(P0,Q0)*e(-P0,2Q0) = e(P0,Q0)^2 * e(P0,Q0)^-2
+    #          — the slots genuinely cancel [passes]
     g1 = np.stack([
         np.stack([BJ.g1_to_limbs(gt.ec_mul(Ps[0], 2)),
                   BJ.g1_to_limbs(gt.ec_neg(Ps[0])),
                   BJ.g1_to_limbs(Ps[1])]),
         np.stack([BJ.g1_to_limbs(Ps[2]), BJ.g1_to_limbs(Ps[3]),
                   BJ.g1_to_limbs(gt.ec_mul(Ps[2], 5))]),
+        np.stack([BJ.g1_to_limbs(Ps[0]), BJ.g1_to_limbs(Ps[0]),
+                  BJ.g1_to_limbs(gt.ec_neg(Ps[0]))]),
     ])
     g2 = np.stack([
         np.stack([BJ.g2_to_limbs(Qs[0]),
                   BJ.g2_to_limbs(gt.ec_mul(Qs[0], 2)),
-                  BJ.g2_to_limbs(gt.ec_neg(Qs[1]) if False else Qs[1])]),
+                  BJ.g2_to_limbs(Qs[1])]),
         np.stack([BJ.g2_to_limbs(Qs[2]), BJ.g2_to_limbs(Qs[3]),
                   BJ.g2_to_limbs(gt.ec_mul(Qs[2], 7))]),
+        np.stack([BJ.g2_to_limbs(Qs[0]), BJ.g2_to_limbs(Qs[0]),
+                  BJ.g2_to_limbs(gt.ec_mul(Qs[0], 2))]),
     ])
-    # make group 0's third slot cancel: pair (P1, Q1) and (-P1, Q1)... use
-    # the identity e(P1,Q1)*e(-P1,Q1)=1 by replacing slot 3 of group 0
-    g1[0, 2] = BJ.g1_to_limbs(Ps[1])
-    g2[0, 2] = BJ.g2_to_limbs(Qs[1])
-    g1 = np.concatenate([g1, g1[0:1]], axis=0)
-    g2c = g2.copy()
-    g2 = np.concatenate([g2, g2c[0:1]], axis=0)
-    g1[2, 2] = BJ.g1_to_limbs(gt.ec_neg(Ps[1]))   # now group 2 passes fully
 
     G, P = g1.shape[0], g1.shape[1]
     f_grouped = np.asarray(BJ._miller_loop_grouped_jit(jnp.asarray(g1),
@@ -343,6 +341,7 @@ def test_grouped_miller_matches_pairwise_product():
     verdict_pair = np.asarray(BJ._group_product_is_one_jit(
         jnp.asarray(fs_pair.reshape((G, P) + fs_pair.shape[1:]))))
     assert np.array_equal(verdict_grouped, verdict_pair)
+    assert not bool(verdict_grouped[0])      # stray e(P1,Q1) spoils group 0
     assert not bool(verdict_grouped[1])      # the failing group fails
     assert bool(verdict_grouped[2])          # the canceling group passes
     # value-level agreement (not just verdicts): group products equal

@@ -13,6 +13,7 @@ assert byte-identical outcomes:
      model's differential copy must not be rooted from device columns).
 """
 from copy import deepcopy
+from types import SimpleNamespace
 
 import pytest
 
@@ -110,6 +111,72 @@ def test_resident_root_backend_declines_foreign_state(spec):
         assert spec.hash_tree_root(other) == hash_tree_root(other)
     finally:
         core.exit()
+
+
+def test_overrides_delegate_for_foreign_state(spec):
+    """The _install overrides mirror the _state_root guard: a state other
+    than the resident one (fork choice's justified state, a differential
+    copy) must be answered from ITS registry via the saved object path,
+    not from the resident device mirrors."""
+    from consensus_specs_tpu.models.phase0.fork_choice import Store, get_head
+
+    state = factories.seed_genesis_state(spec, 8)
+    res = deepcopy(state)
+    justified = deepcopy(state)
+    # diverge the justified state's registry: validators 0-3 exited, and a
+    # distinct effective balance on validator 4
+    epoch = spec.slot_to_epoch(justified.slot)
+    for i in range(4):
+        justified.validator_registry[i].exit_epoch = epoch
+    justified.validator_registry[4].effective_balance -= \
+        spec.EFFECTIVE_BALANCE_INCREMENT
+
+    core = ResidentCore(spec, res)
+    try:
+        with core.suspended():
+            want_active = spec.get_active_validator_indices(justified, epoch)
+            want_total = spec.get_total_balance(justified, want_active)
+            want_eb = spec.effective_balance_of(justified, 4)
+        # overrides installed: foreign state -> object-path answers
+        assert list(spec.get_active_validator_indices(justified, epoch)) \
+            == list(want_active) == [4, 5, 6, 7]
+        assert spec.get_total_balance(justified, want_active) == want_total
+        assert spec.effective_balance_of(justified, 4) == want_eb
+        # ... while the resident state still answers from the mirrors
+        assert list(spec.get_active_validator_indices(res, epoch)) \
+            == list(range(8))
+
+        # end to end through fork choice's justified-state path: votes of
+        # the justified-exited validators 0-3 must not count
+        store = Store()
+        root_g, root_a, root_b = (bytes([9]) + bytes(31),
+                                  bytes([1]) + bytes(31),
+                                  bytes([2]) + bytes(31))
+        store.add_block(root_g, SimpleNamespace(slot=0), None)
+        store.add_block(root_a, SimpleNamespace(slot=1), root_g)
+        store.add_block(root_b, SimpleNamespace(slot=1), root_g)
+        store.on_attestation([0, 1, 2, 3], root_a, slot=1)   # exited
+        store.on_attestation([5, 6, 7], root_b, slot=1)      # active
+        assert get_head(spec, store, justified) == root_b
+    finally:
+        core.exit()
+
+
+def test_light_core_refuses_state_transition(spec):
+    """A checkpoint-resumed (light) core must fail loudly BEFORE
+    process_slots mutates state: block processing needs the object
+    registry the light entry deliberately never built."""
+    state = factories.seed_genesis_state(spec, 2 * spec.SLOTS_PER_EPOCH)
+    data = serialize(state, spec.BeaconState)
+    core = ResidentCore.from_checkpoint(spec, data)
+    try:
+        block = SimpleNamespace(slot=int(state.slot) + 1)
+        before = int(core.state.slot)
+        with pytest.raises(NotImplementedError):
+            core.state_transition(core.state, block)
+        assert int(core.state.slot) == before   # nothing mutated
+    finally:
+        core._uninstall()
 
 
 def test_checkpoint_resume_light_residency(spec):

@@ -1,0 +1,24 @@
+"""Multi-pass JAX/TPU trace-safety & spec-conformance static analyzer.
+
+Stdlib-only (like tools/lint.py and tools/cov.py): every pass is pure
+`ast` walking — no third-party linters, no imports of the analyzed code.
+The bug classes it gates are the ones that break the pyspec->TPU lift
+(PAPER.md §1) yet pass both the test suite (which runs with x64 enabled
+and small states) and tools/lint.py (syntax/style only):
+
+  CSA1xx  trace-safety    Python control flow / host casts on traced values
+  CSA2xx  dtype-width     uint64 Gwei/slot math through 32-bit defaults
+  CSA3xx  purity          host side effects baked into traced programs
+  CSA4xx  state-aliasing  `state` parameters a body never consults
+  CSA5xx  jit-cache       retrace storms and unhashable static arguments
+
+Entry points:
+  python -m tools.analysis <targets> [--json out.json] [--baseline b.json]
+  make analyze
+
+See tools/analysis/README.md for the rule catalog and suppression syntax
+(`# csa: ignore[CSA101]` on the flagged line or the line above).
+"""
+from .core import (Finding, Rule, RULES, PASSES, register_pass,  # noqa: F401
+                   register_rule, analyze_paths, load_baseline)
+from . import passes  # noqa: F401  (importing registers the passes)

@@ -1,0 +1,64 @@
+"""CLI: python -m tools.analysis <targets> [--json out] [--baseline b.json]
+
+Exit status: 0 when every finding is inline-suppressed or baselined,
+1 when actionable findings remain, 2 on usage errors. Stale baseline
+entries (nothing matches them any more) are reported but do not fail the
+run — they are the ratchet's cue to shrink the file.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import analyze_paths, load_baseline
+from .core import RULES, render_human, render_json, write_baseline
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="JAX/TPU trace-safety & spec-conformance analyzer")
+    parser.add_argument("targets", nargs="*",
+                        help="files or directories to analyze")
+    parser.add_argument("--json", metavar="PATH",
+                        help="also write a JSON report")
+    parser.add_argument("--baseline", metavar="PATH",
+                        help="baseline file of accepted findings")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite --baseline from current findings")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULES.values(), key=lambda r: r.id):
+            print(f"{rule.id}  {rule.severity:7s} {rule.summary}")
+        return 0
+    if not args.targets:
+        parser.print_usage(sys.stderr)
+        return 2
+
+    baseline = load_baseline(args.baseline)
+    report = analyze_paths(args.targets, baseline)
+
+    if args.update_baseline:
+        if not args.baseline:
+            print("--update-baseline requires --baseline", file=sys.stderr)
+            return 2
+        # keep still-live baselined findings (and their reasons) alongside
+        # the new ones; only entries nothing matches any more drop out
+        keep = report.findings + report.baselined
+        write_baseline(args.baseline, keep, prior=baseline)
+        print(f"baseline: wrote {len(keep)} entr(y|ies) to {args.baseline}")
+        return 0
+
+    print(render_human(report))
+    if args.json:
+        Path(args.json).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.json).write_text(render_json(report) + "\n")
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

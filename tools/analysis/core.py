@@ -1,0 +1,267 @@
+"""Framework: Finding, rule registry, suppressions, baseline, reporters.
+
+A *rule* is an identifier + severity + documentation. A *pass* is a
+function `(module: ModuleInfo) -> Iterable[Finding]`; passes register
+themselves at import time (tools/analysis/passes/__init__.py imports each
+pass module). The driver parses every target file once, hands the shared
+`ModuleInfo` (source, AST, suppression map, lazily-built jit-context map)
+to each pass, then filters the findings through inline suppressions and
+the committed baseline.
+
+Suppression syntax (checked on the finding's line and the line above):
+
+    x = int(flag)  # csa: ignore[CSA102] -- host cast is deliberate here
+    # csa: ignore[CSA401]
+    def handler(state, msg): ...
+
+Baseline (tools/analysis/baseline.json): a list of fingerprint entries,
+each with a mandatory human reason. A baselined finding is reported as
+suppressed, not failed — the ratchet: new code cannot add findings, and
+deleting fixed entries shrinks the file monotonically.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field, asdict
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional
+
+SEVERITIES = ("error", "warning")
+
+_SUPPRESS_RE = re.compile(r"#\s*csa:\s*ignore\[([A-Za-z0-9_*,\s]+)\]")
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    summary: str
+    severity: str
+    hint: str = ""
+
+
+RULES: Dict[str, Rule] = {}
+PASSES: List[Callable] = []
+
+
+def register_rule(rule_id: str, summary: str, severity: str,
+                  hint: str = "") -> Rule:
+    assert severity in SEVERITIES, severity
+    assert rule_id not in RULES, f"duplicate rule {rule_id}"
+    rule = Rule(rule_id, summary, severity, hint)
+    RULES[rule_id] = rule
+    return rule
+
+
+def register_pass(fn: Callable) -> Callable:
+    PASSES.append(fn)
+    return fn
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    context: str = ""   # enclosing function qualname — line-stable identity
+
+    @property
+    def severity(self) -> str:
+        return RULES[self.rule].severity
+
+    @property
+    def hint(self) -> str:
+        return RULES[self.rule].hint
+
+    def fingerprint(self) -> str:
+        """Line-number-independent identity used by the baseline: findings
+        survive unrelated edits above them but change when the enclosing
+        function or the message (which names the offending code) does."""
+        return f"{self.path}::{self.rule}::{self.context}::{self.message}"
+
+
+@dataclass
+class ModuleInfo:
+    """Everything the passes need about one parsed file."""
+    path: str
+    source: str
+    tree: ast.Module
+    lines: List[str]
+    # line -> set of suppressed rule ids ("*" = all)
+    suppressions: Dict[int, set] = field(default_factory=dict)
+    _jit_map: Optional[object] = None  # lazily-built passes_jitmap.JitMap
+    _qualnames: Optional[Dict[int, str]] = None  # id(node) -> dotted name
+
+    @property
+    def jit_map(self):
+        if self._jit_map is None:
+            from . import jitmap
+            self._jit_map = jitmap.build(self.tree)
+        return self._jit_map
+
+    def qualname(self, node: ast.AST) -> str:
+        """Scope-qualified name (`Outer._install.get_total_balance`) so
+        fingerprints of same-named functions in one file don't collide."""
+        if self._qualnames is None:
+            names: Dict[int, str] = {}
+
+            def visit(parent: ast.AST, prefix: str):
+                for child in ast.iter_child_nodes(parent):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef)):
+                        q = f"{prefix}.{child.name}" if prefix else child.name
+                        names[id(child)] = q
+                        visit(child, q)
+                    else:
+                        visit(child, prefix)
+            visit(self.tree, "")
+            self._qualnames = names
+        return self._qualnames.get(id(node), getattr(node, "name", ""))
+
+    def suppressed(self, finding: Finding) -> bool:
+        for line in (finding.line, finding.line - 1):
+            rules = self.suppressions.get(line)
+            if rules and ("*" in rules or finding.rule in rules):
+                return True
+        return False
+
+
+def _parse_suppressions(source: str) -> Dict[int, set]:
+    out: Dict[int, set] = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def load_module(path: Path) -> Optional[ModuleInfo]:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError:
+        return None  # tools/lint.py owns the syntax gate
+    return ModuleInfo(path=str(path), source=source, tree=tree,
+                      lines=source.splitlines(),
+                      suppressions=_parse_suppressions(source))
+
+
+def iter_py_files(targets: Iterable[str]):
+    for target in targets:
+        path = Path(target)
+        if path.is_dir():
+            yield from sorted(path.rglob("*.py"))
+        elif path.suffix == ".py":
+            yield path
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: Optional[str]) -> Dict[str, str]:
+    """fingerprint -> reason. Missing file = empty baseline."""
+    if not path or not Path(path).exists():
+        return {}
+    data = json.loads(Path(path).read_text())
+    out = {}
+    for entry in data.get("entries", []):
+        out[entry["fingerprint"]] = entry.get("reason", "")
+    return out
+
+
+def write_baseline(path: str, findings: List[Finding],
+                   prior: Optional[Dict[str, str]] = None) -> None:
+    """Write the baseline for `findings`. `prior` (fingerprint -> reason)
+    preserves hand-written reasons for entries that are still live —
+    pass every finding that should stay accepted (actionable AND already-
+    baselined), or refreshing the file would silently drop live entries."""
+    prior = prior or {}
+    seen = set()
+    entries = []
+    for f in findings:
+        fp = f.fingerprint()
+        if fp in seen:
+            continue
+        seen.add(fp)
+        entries.append({"fingerprint": fp, "rule": f.rule,
+                        "reason": prior.get(fp) or "TODO: justify or fix"})
+    Path(path).write_text(json.dumps(
+        {"version": 1,
+         "comment": "Accepted findings; every entry needs a reason. "
+                    "Delete entries as the code they cover is fixed.",
+         "entries": entries}, indent=2) + "\n")
+
+
+# -- driver -----------------------------------------------------------------
+
+@dataclass
+class Report:
+    findings: List[Finding]            # actionable (not suppressed/baselined)
+    suppressed: List[Finding]          # inline-suppressed
+    baselined: List[Finding]           # matched a baseline entry
+    stale_baseline: List[str]          # baseline fingerprints nothing matched
+    files_checked: int = 0
+
+
+def analyze_paths(targets: Iterable[str],
+                  baseline: Optional[Dict[str, str]] = None) -> Report:
+    baseline = baseline or {}
+    actionable: List[Finding] = []
+    suppressed: List[Finding] = []
+    baselined: List[Finding] = []
+    matched = set()
+    files = 0
+    for path in iter_py_files(targets):
+        mod = load_module(path)
+        if mod is None:
+            continue
+        files += 1
+        for pass_fn in PASSES:
+            for finding in pass_fn(mod):
+                if mod.suppressed(finding):
+                    suppressed.append(finding)
+                elif finding.fingerprint() in baseline:
+                    matched.add(finding.fingerprint())
+                    baselined.append(finding)
+                else:
+                    actionable.append(finding)
+    stale = sorted(set(baseline) - matched)
+    actionable.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Report(findings=actionable, suppressed=suppressed,
+                  baselined=baselined, stale_baseline=stale,
+                  files_checked=files)
+
+
+# -- reporters --------------------------------------------------------------
+
+def render_human(report: Report) -> str:
+    out = []
+    for f in report.findings:
+        out.append(f"{f.path}:{f.line}: [{f.rule}] {f.severity}: {f.message}")
+        if f.hint:
+            out.append(f"    hint: {f.hint}")
+    for fp in report.stale_baseline:
+        out.append(f"baseline: stale entry (fixed? delete it): {fp}")
+    out.append(f"analysis: {report.files_checked} files, "
+               f"{len(report.findings)} finding(s), "
+               f"{len(report.suppressed)} suppressed, "
+               f"{len(report.baselined)} baselined")
+    return "\n".join(out)
+
+
+def render_json(report: Report) -> str:
+    def row(f: Finding):
+        d = asdict(f)
+        d.pop("context")
+        d.update(severity=f.severity, hint=f.hint,
+                 fingerprint=f.fingerprint())
+        return d
+    return json.dumps({
+        "findings": [row(f) for f in report.findings],
+        "suppressed": [row(f) for f in report.suppressed],
+        "baselined": [row(f) for f in report.baselined],
+        "stale_baseline": report.stale_baseline,
+        "files_checked": report.files_checked,
+    }, indent=2)

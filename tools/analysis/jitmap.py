@@ -1,0 +1,369 @@
+"""Shared jit-context discovery for the trace-sensitive passes.
+
+Identifies, per module, which function definitions execute under a JAX
+trace, and which of their parameters are traced (vs static). Three ways a
+function enters jit context, all used in this codebase:
+
+  decorator      @jax.jit / @jit / @partial(jax.jit, static_argnums=(0,))
+  wrapper assign _f_jit = jax.jit(f)           (ops/bls_jax.py:394)
+                 _g = partial(jax.jit, ...)(g) (models/phase0/epoch_soa.py:367)
+  transitive     a plain def called (by name, same module) from any
+                 jit-context function — the "scan callees" requirement,
+                 e.g. _total_balance / _stage_a_traced in epoch_soa.py
+
+Static parameters come from static_argnums / static_argnames on the jit
+call. For transitive callees no static info exists; a parameter there is
+treated as traced unless its annotation names a clearly-host type (int,
+bool, bytes, str, *Config, ...) — the repo consistently annotates traced
+params `jnp.ndarray`, so this keeps config plumbing out of the taint set.
+Nested defs inherit jit context from their enclosing function (fori_loop /
+cond / scan bodies) with all parameters traced.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# Annotations that mean "host-side value, not a tracer" on callee params.
+_HOST_ANNOTATIONS = {"int", "bool", "float", "str", "bytes", "bytearray",
+                     "list", "tuple", "dict", "set", "List", "Tuple",
+                     "Dict", "Set", "Sequence", "Optional", "Callable"}
+
+
+@dataclass
+class JitFunc:
+    node: ast.AST                  # FunctionDef (or Lambda) in jit context
+    qualname: str
+    direct: bool                   # decorated/wrapped vs transitive callee
+    traced_params: Set[str] = field(default_factory=set)
+    static_params: Set[str] = field(default_factory=set)
+    # the jit(...) call node that created it, for static_argnums checks
+    jit_call: Optional[ast.Call] = None
+
+
+@dataclass
+class JitMap:
+    funcs: Dict[ast.AST, JitFunc] = field(default_factory=dict)
+    # module-level names that resolve to a jitted callable (for call-site
+    # passes): name -> the wrapped FunctionDef (or None if unresolvable)
+    jitted_names: Dict[str, Optional[ast.FunctionDef]] = field(
+        default_factory=dict)
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute chains, 'jit' for Name, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_name(dotted: str) -> bool:
+    return dotted in ("jit", "jax.jit", "pjit", "jax.pjit") or \
+        dotted.endswith(".jit") or dotted.endswith(".pjit")
+
+
+def _jit_call_of(node: ast.AST) -> Optional[ast.Call]:
+    """The Call node carrying static_argnums if `node` is a jit
+    application: jax.jit, jit, partial(jax.jit, ...)."""
+    if isinstance(node, ast.Call):
+        dotted = _dotted(node.func)
+        if _is_jit_name(dotted):
+            return node
+        # partial(jax.jit, static_argnums=...) — the partial call holds
+        # the kwargs; report it as the carrier
+        if dotted in ("partial", "functools.partial") and node.args:
+            if _is_jit_name(_dotted(node.args[0])):
+                return node
+    elif isinstance(node, (ast.Attribute, ast.Name)):
+        if _is_jit_name(_dotted(node)):
+            # bare @jax.jit decorator: no kwargs to carry
+            return ast.Call(func=node, args=[], keywords=[])
+    return None
+
+
+def static_info(jit_call: Optional[ast.Call],
+                fn: ast.FunctionDef) -> Tuple[Set[str], Set[str]]:
+    """(static param names, traced param names) for a DIRECTLY jitted fn."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static: Set[str] = set()
+    if jit_call is not None:
+        for kw in jit_call.keywords:
+            if kw.arg == "static_argnums":
+                for idx in _const_ints(kw.value):
+                    if 0 <= idx < len(params):
+                        static.add(params[idx])
+            elif kw.arg == "static_argnames":
+                static.update(_const_strs(kw.value))
+    traced = {p for p in params if p not in static and p != "self"}
+    return static, traced
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            out.extend(_const_ints(elt))
+        return out
+    return []
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            out.extend(_const_strs(elt))
+        return out
+    return []
+
+
+def _annotation_is_host(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Subscript):   # List[int], Optional[bytes], ...
+        ann = ann.value
+    name = _dotted(ann)
+    base = name.split(".")[-1]
+    return base in _HOST_ANNOTATIONS or base.endswith("Config")
+
+
+def _callee_params(fn: ast.FunctionDef) -> Tuple[Set[str], Set[str]]:
+    """(static, traced) for a transitive callee: annotation-driven."""
+    static: Set[str] = set()
+    traced: Set[str] = set()
+    for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+        if a.arg == "self" or _annotation_is_host(a.annotation):
+            static.add(a.arg)
+        else:
+            traced.add(a.arg)
+    return static, traced
+
+
+def build(tree: ast.Module) -> JitMap:
+    jmap = JitMap()
+    # module-level defs by name (for wrapper-assign + call-graph edges)
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+
+    # 1. decorator form
+    for fn in defs.values():
+        for deco in fn.decorator_list:
+            jit_call = _jit_call_of(deco)
+            if jit_call is not None:
+                static, traced = static_info(jit_call, fn)
+                jmap.funcs[fn] = JitFunc(fn, fn.name, direct=True,
+                                         traced_params=traced,
+                                         static_params=static,
+                                         jit_call=jit_call)
+                jmap.jitted_names[fn.name] = fn
+                break
+
+    # 2. wrapper-assignment form: name = jax.jit(f) / partial(jax.jit,..)(f)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        wrapped: Optional[ast.AST] = None
+        jit_call: Optional[ast.Call] = None
+        if _is_jit_name(_dotted(call.func)) and call.args:
+            wrapped, jit_call = call.args[0], call
+        elif isinstance(call.func, ast.Call):
+            inner = _jit_call_of(call.func)
+            if inner is not None and call.args:
+                wrapped, jit_call = call.args[0], inner
+        if wrapped is None:
+            continue
+        target_names = [t.id for t in node.targets
+                        if isinstance(t, ast.Name)]
+        fn = defs.get(_dotted(wrapped))
+        for name in target_names:
+            jmap.jitted_names[name] = fn
+        if fn is not None and fn not in jmap.funcs:
+            static, traced = static_info(jit_call, fn)
+            jmap.funcs[fn] = JitFunc(fn, fn.name, direct=True,
+                                     traced_params=traced,
+                                     static_params=static,
+                                     jit_call=jit_call)
+
+    # 2b. jit-factory form: a def (module-level OR nested) passed BY NAME
+    # into any call whose callee mentions "jit" (utils/ssz/bulk.py's
+    # memoizing `_get_root_jit(name, fn)` over a nested `both`). No static
+    # info exists at that distance: annotation-driven params.
+    all_defs: Dict[str, ast.FunctionDef] = {
+        n.name: n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee_name = _dotted(node.func).split(".")[-1].lower()
+        if "jit" not in callee_name:
+            continue
+        for arg in node.args:
+            fn = all_defs.get(_dotted(arg))
+            if fn is not None and fn not in jmap.funcs:
+                static, traced = _callee_params(fn)
+                jmap.funcs[fn] = JitFunc(fn, fn.name, direct=False,
+                                         traced_params=traced,
+                                         static_params=static)
+
+    # 3. transitive callees: names called from jit-context bodies
+    worklist = [jf.node for jf in jmap.funcs.values()]
+    seen = set(id(n) for n in worklist)
+    while worklist:
+        fn = worklist.pop()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                callee = defs.get(node.func.id)
+                if callee is not None and callee not in jmap.funcs:
+                    static, traced = _callee_params(callee)
+                    jmap.funcs[callee] = JitFunc(
+                        callee, callee.name, direct=False,
+                        traced_params=traced, static_params=static)
+                    if id(callee) not in seen:
+                        seen.add(id(callee))
+                        worklist.append(callee)
+    return jmap
+
+
+# -- taint ------------------------------------------------------------------
+
+# Calls whose RESULT is host-side even when arguments are traced: shape
+# inspection is static during tracing.
+_UNTAINT_CALLS = {"len", "range", "isinstance", "type", "id", "enumerate",
+                  "zip"}
+_UNTAINT_ATTRS = {"shape", "dtype", "ndim", "size", "_fields"}
+# Roots whose calls produce traced values.
+_TRACED_ROOTS = {"jnp", "lax"}
+
+
+def _expr_names(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class Taint:
+    """Flow-insensitive taint over one function body: which local names
+    (can) hold traced values. Seeds from traced params; propagates through
+    assignment until fixpoint. `jnp.*` / `jax.lax.*` / `jax.numpy.*` call
+    results are traced; `.shape`/`.dtype`/len() are not."""
+
+    def __init__(self, fn: ast.AST, traced_params: Set[str]):
+        self.tainted: Set[str] = set(traced_params)
+        body = fn.body if isinstance(
+            fn, (ast.FunctionDef, ast.AsyncFunctionDef)) else [fn]
+        changed = True
+        while changed:
+            changed = False
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    targets: List[ast.AST] = []
+                    value: Optional[ast.AST] = None
+                    if isinstance(node, ast.Assign):
+                        targets, value = node.targets, node.value
+                    elif isinstance(node, ast.AugAssign):
+                        targets, value = [node.target], node.value
+                    elif isinstance(node, ast.AnnAssign) and node.value:
+                        targets, value = [node.target], node.value
+                    elif isinstance(node, ast.NamedExpr):
+                        # walrus: `(s := jnp.sum(x))` binds like an Assign
+                        targets, value = [node.target], node.value
+                    elif isinstance(node, (ast.For, ast.comprehension)):
+                        # iterating a traced value taints the loop var
+                        it = node.iter
+                        tgt = node.target
+                        if self.expr_tainted(it):
+                            targets, value = [tgt], it
+                    if value is None or not self.expr_tainted(value):
+                        continue
+                    for t in targets:
+                        for name in _assigned_names(t):
+                            if name not in self.tainted:
+                                self.tainted.add(name)
+                                changed = True
+
+    def expr_tainted(self, node: ast.AST) -> bool:
+        return self._tainted(node)
+
+    def _tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _UNTAINT_ATTRS:
+                return False
+            return self._tainted(node.value)
+        if isinstance(node, ast.Call):
+            fname = _dotted(node.func)
+            root = fname.split(".")[0]
+            if fname in _UNTAINT_CALLS or root in ("np", "numpy", "math"):
+                return False
+            if root in _TRACED_ROOTS or fname.startswith("jax."):
+                return True
+            if isinstance(node.func, ast.Attribute):
+                # method call: traced iff the receiver is (covers .at[..]
+                # .set/.add, .astype, .reshape, ...)
+                return self._tainted(node.func.value)
+            # plain-name call (helper fn): traced iff any argument is —
+            # conservative for same-module numeric helpers
+            return any(self._tainted(a) for a in node.args) or \
+                any(self._tainted(k.value) for k in node.keywords)
+        if isinstance(node, (ast.BinOp, ast.BoolOp, ast.Compare,
+                             ast.UnaryOp, ast.Subscript, ast.IfExp,
+                             ast.Tuple, ast.List, ast.Starred,
+                             ast.NamedExpr)):
+            return any(self._tainted(c) for c in ast.iter_child_nodes(node)
+                       if not isinstance(c, (ast.cmpop, ast.operator,
+                                             ast.boolop, ast.unaryop,
+                                             ast.expr_context)))
+        return False
+
+
+def _assigned_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for elt in target.elts:
+            out.extend(_assigned_names(elt))
+        return out
+    return []   # attribute/subscript targets: not a simple name binding
+
+
+def own_nodes(fn: ast.AST):
+    """ast.walk over a function's OWN body, stopping at nested function
+    boundaries (nested defs are yielded separately by iter_jit_functions,
+    with their own Taint — descending here would double-report)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def iter_jit_functions(jmap: JitMap):
+    """Yield (JitFunc, Taint) for every jit-context function, including
+    nested defs (which inherit context, all params traced)."""
+    for jf in jmap.funcs.values():
+        taint = Taint(jf.node, jf.traced_params)
+        yield jf, taint
+        for node in ast.walk(jf.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not jf.node:
+                params = {a.arg for a in node.args.posonlyargs
+                          + node.args.args + node.args.kwonlyargs}
+                nested = JitFunc(node, f"{jf.qualname}.{node.name}",
+                                 direct=False, traced_params=params)
+                yield nested, Taint(node, params | taint.tainted)

@@ -1,0 +1,6 @@
+"""Importing this package registers every analysis pass with core.PASSES."""
+from . import trace_safety  # noqa: F401
+from . import dtype_width   # noqa: F401
+from . import purity        # noqa: F401
+from . import state_aliasing  # noqa: F401
+from . import jit_cache     # noqa: F401

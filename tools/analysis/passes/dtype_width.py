@@ -1,0 +1,99 @@
+"""CSA2xx — uint64 Gwei/slot math through 32-bit-defaulting constructs.
+
+Balances are uint64 Gwei and epochs/slots are uint64 (reference SSZ
+types); JAX's default integer dtype is 32-bit unless jax_enable_x64 is
+set (ops/intmath.py sets it on import, but only for programs that import
+it). An array constructor without an explicit dtype, or a bare Python
+int literal wider than 31 bits mixed into traced arithmetic, silently
+truncates on any path that misses the x64 import — the house style is
+`u64(...)` / `dtype=jnp.uint64` everywhere (epoch_soa.py).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, register_pass, register_rule
+from .. import jitmap
+
+register_rule(
+    "CSA201",
+    "jnp array constructor without an explicit dtype in a jitted function",
+    "warning",
+    "pass dtype=jnp.uint64 (Gwei/epoch math) or the intended narrow "
+    "dtype explicitly; the 32-bit default truncates without x64",
+)
+register_rule(
+    "CSA202",
+    "Python int literal wider than 31 bits in traced arithmetic",
+    "error",
+    "wrap the literal: u64(...) / jnp.uint64(...) — bare wide literals "
+    "overflow the default 32-bit lane",
+)
+
+# dtype-defaulting constructors; array/asarray only flagged for integer
+# payloads (copying an existing array preserves its dtype).
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty", "arange"}
+_COPY_CTORS = {"array", "asarray"}
+_WIDE = 2 ** 31
+
+
+def _int_payload(node: ast.AST) -> bool:
+    """Expression is an int literal or a list/tuple of them."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, int) and not isinstance(node.value, bool)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return bool(node.elts) and all(_int_payload(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _int_payload(node.operand)
+    return False
+
+
+def _wide_literal(node: ast.AST):
+    """The int value if node is a bare wide literal (incl. 2**40 style)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool) and abs(node.value) >= _WIDE:
+        return node.value
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Pow) and \
+            isinstance(node.left, ast.Constant) and \
+            isinstance(node.right, ast.Constant):
+        try:
+            value = node.left.value ** node.right.value
+        except Exception:
+            return None
+        if isinstance(value, int) and abs(value) >= _WIDE:
+            return value
+    return None
+
+
+@register_pass
+def run(mod):
+    findings = []
+    for jf, taint in jitmap.iter_jit_functions(mod.jit_map):
+        for node in jitmap.own_nodes(jf.node):
+            if isinstance(node, ast.Call):
+                fname = jitmap._dotted(node.func)
+                root, _, ctor = fname.rpartition(".")
+                if root in ("jnp", "jax.numpy"):
+                    has_dtype = any(k.arg == "dtype" for k in node.keywords)
+                    payload_ok = (ctor in _SHAPE_CTORS
+                                  or (ctor in _COPY_CTORS and node.args
+                                      and _int_payload(node.args[0])))
+                    if payload_ok and not has_dtype:
+                        findings.append(Finding(
+                            "CSA201", mod.path, node.lineno,
+                            f"`jnp.{ctor}(...)` without dtype in jitted "
+                            f"`{jf.qualname}`",
+                            context=jf.qualname))
+            elif isinstance(node, ast.BinOp) and \
+                    not isinstance(node.op, ast.Pow):
+                for lit_node, other in ((node.left, node.right),
+                                        (node.right, node.left)):
+                    value = _wide_literal(lit_node)
+                    if value is not None and taint.expr_tainted(other):
+                        findings.append(Finding(
+                            "CSA202", mod.path, node.lineno,
+                            f"bare int literal {value} in traced "
+                            f"arithmetic in jitted `{jf.qualname}`",
+                            context=jf.qualname))
+                        break
+    return findings

@@ -1,0 +1,120 @@
+"""CSA5xx — jit compilation-cache hygiene.
+
+CSA501: a jitted callable invoked with a bare Python scalar (or a fresh
+`int()` / `float()` / `len()` result) in a traced positional slot. Weak-
+typed scalars commit to a different dtype than the arrays the tests
+traced with, so the first production call recompiles — and a scalar that
+VARIES (slot counters, validator counts) whose parameter later feeds a
+shape recompiles per value: the retrace-storm class.
+
+CSA502: static_argnums/static_argnames naming a parameter whose
+annotation or default is unhashable (list/dict/set/ndarray). jit hashes
+static arguments for the program cache; this raises TypeError on the
+first call with a non-trivial value — but only on the code path that
+passes one, which tests that always use the default never exercise.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, register_pass, register_rule
+from .. import jitmap
+
+register_rule(
+    "CSA501",
+    "Python scalar passed positionally into a jitted callable's traced slot",
+    "warning",
+    "pass jnp.asarray(x, dtype=...) to pin the dtype, or declare the "
+    "parameter static if it is genuinely shape-like",
+)
+register_rule(
+    "CSA502",
+    "static_argnums/static_argnames names an unhashable parameter",
+    "error",
+    "static arguments are dict keys of the compilation cache; pass "
+    "arrays as traced args, or convert to tuple before the call",
+)
+
+_UNHASHABLE_ANNOTATIONS = {"list", "dict", "set", "List", "Dict", "Set",
+                           "ndarray", "Array", "DeviceArray"}
+_SCALAR_MAKERS = {"int", "float", "len"}
+
+
+def _is_scalar_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) and \
+            not isinstance(node.value, bool)
+    if isinstance(node, ast.Call):
+        return jitmap._dotted(node.func) in _SCALAR_MAKERS
+    return False
+
+
+def _annotation_unhashable(ann) -> bool:
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Subscript):
+        ann = ann.value
+    name = jitmap._dotted(ann)
+    return name.split(".")[-1] in _UNHASHABLE_ANNOTATIONS
+
+
+@register_pass
+def run(mod):
+    findings = []
+    jmap = mod.jit_map
+
+    # CSA502 — inspect each directly-jitted function's static params
+    for jf in jmap.funcs.values():
+        if not jf.direct or jf.jit_call is None:
+            continue
+        fn = jf.node
+        args = fn.args.posonlyargs + fn.args.args
+        defaults = dict(zip([a.arg for a in args[len(args)
+                                                 - len(fn.args.defaults):]],
+                            fn.args.defaults))
+        for a in args:
+            if a.arg not in jf.static_params:
+                continue
+            bad = _annotation_unhashable(a.annotation)
+            default = defaults.get(a.arg)
+            if default is not None and isinstance(
+                    default, (ast.List, ast.Dict, ast.Set)):
+                bad = True
+            if bad:
+                findings.append(Finding(
+                    "CSA502", mod.path, fn.lineno,
+                    f"static param `{a.arg}` of jitted `{fn.name}` is "
+                    f"unhashable by annotation/default",
+                    context=fn.name))
+
+    # CSA501 — call sites of known-jitted names, module-wide. Plain Name
+    # calls only: an attribute call (store.update(...)) whose final
+    # segment happens to match a jitted name is some other object's method
+    jitted = {name: fn for name, fn in jmap.jitted_names.items()}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Name):
+            continue
+        base = node.func.id
+        if base not in jitted:
+            continue
+        fn = jitted[base]
+        static = set()
+        params = []
+        if fn is not None:
+            for jf in jmap.funcs.values():
+                if jf.node is fn:
+                    static = jf.static_params
+                    break
+            params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        for i, arg in enumerate(node.args):
+            pname = params[i] if i < len(params) else None
+            if pname is not None and pname in static:
+                continue
+            if _is_scalar_expr(arg):
+                findings.append(Finding(
+                    "CSA501", mod.path, node.lineno,
+                    f"scalar positional arg {i} to jitted `{base}` "
+                    f"(traced slot `{pname or i}`)",
+                    context=base))
+    return findings

@@ -1,0 +1,78 @@
+"""CSA3xx — host side effects inside traced programs.
+
+A jitted function body runs ONCE, at trace time; `time.time()` /
+`random.random()` / `np.random.*` results are baked into the compiled
+program as constants, and mutation of globals or argument attributes
+happens at trace time only — every later cached-program call skips it.
+Both are silent wrong-answer classes, not crashes.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, register_pass, register_rule
+from .. import jitmap
+
+register_rule(
+    "CSA301",
+    "impure host call (time/random) inside a jitted function",
+    "error",
+    "thread entropy in as a jax.random key argument; take timestamps "
+    "outside the traced program",
+)
+register_rule(
+    "CSA302",
+    "`global` declaration inside a jitted function",
+    "error",
+    "trace-time global writes run once, not per call; return the value "
+    "instead",
+)
+register_rule(
+    "CSA303",
+    "mutation of a parameter/global object inside a jitted function",
+    "error",
+    "tracer-backed containers cannot be mutated in place; use the "
+    "functional .at[...].set(...) form and return the result",
+)
+
+_IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                    "jax.random.PRNGKey")
+
+
+@register_pass
+def run(mod):
+    findings = []
+    for jf, taint in jitmap.iter_jit_functions(mod.jit_map):
+        params = jf.traced_params | jf.static_params
+        for node in jitmap.own_nodes(jf.node):
+            if isinstance(node, ast.Call):
+                fname = jitmap._dotted(node.func)
+                if any(fname.startswith(p) or fname == p.rstrip(".")
+                       for p in _IMPURE_PREFIXES):
+                    findings.append(Finding(
+                        "CSA301", mod.path, node.lineno,
+                        f"impure call `{fname}(...)` in jitted "
+                        f"`{jf.qualname}` — result is frozen at trace time",
+                        context=jf.qualname))
+            elif isinstance(node, ast.Global):
+                findings.append(Finding(
+                    "CSA302", mod.path, node.lineno,
+                    f"`global {', '.join(node.names)}` in jitted "
+                    f"`{jf.qualname}`",
+                    context=jf.qualname))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    root = tgt
+                    while isinstance(root, (ast.Attribute, ast.Subscript)):
+                        root = root.value
+                    if root is tgt or not isinstance(root, ast.Name):
+                        continue   # plain name rebinding is fine
+                    if root.id in params or taint.expr_tainted(root):
+                        findings.append(Finding(
+                            "CSA303", mod.path, node.lineno,
+                            f"in-place mutation of `{root.id}` in jitted "
+                            f"`{jf.qualname}`",
+                            context=jf.qualname))
+    return findings

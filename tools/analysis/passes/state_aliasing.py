@@ -1,0 +1,66 @@
+"""CSA401 — `state` parameters a function body never consults.
+
+The spec's method surface threads `state` through every helper; an
+override or helper that ACCEPTS a state but answers from captured context
+silently returns wrong data the moment a caller passes a different state
+— exactly the resident-mirror bug class (models/phase0/resident.py
+`_install` pre-guard: fork choice hands the JUSTIFIED state to
+spec.get_active_validator_indices, and the override answered from the
+head state's device mirrors). A body that never mentions `state` cannot
+be distinguishing states, so it is either dead API surface or an
+aliasing bug; both deserve a look.
+
+Not flagged: stubs (docstring + pass/.../raise only) — abstract interface
+conformance is the one legitimate shape.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, register_pass, register_rule
+
+register_rule(
+    "CSA401",
+    "function accepts a `state` parameter but never reads it",
+    "error",
+    "answer from the passed state (or delegate when `state is not` the "
+    "one your captured context describes); if the parameter is pure "
+    "interface conformance, suppress with a justification",
+)
+
+_PARAM = "state"
+
+
+def _is_stub(fn: ast.FunctionDef) -> bool:
+    body = fn.body
+    if body and isinstance(body[0], ast.Expr) and \
+            isinstance(body[0].value, ast.Constant) and \
+            isinstance(body[0].value.value, str):
+        body = body[1:]
+    return all(isinstance(s, (ast.Pass, ast.Raise)) or
+               (isinstance(s, ast.Expr) and
+                isinstance(s.value, ast.Constant) and
+                s.value.value is Ellipsis)
+               for s in body)
+
+
+@register_pass
+def run(mod):
+    findings = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = {a.arg for a in node.args.posonlyargs + node.args.args
+                  + node.args.kwonlyargs}
+        if _PARAM not in params or _is_stub(node):
+            continue
+        used = any(isinstance(n, ast.Name) and n.id == _PARAM
+                   for body_stmt in node.body
+                   for n in ast.walk(body_stmt))
+        if not used:
+            findings.append(Finding(
+                "CSA401", mod.path, node.lineno,
+                f"`{node.name}` takes `state` but never reads it — "
+                f"aliasing hazard if it answers from captured context",
+                context=mod.qualname(node)))
+    return findings

@@ -1,0 +1,74 @@
+"""CSA1xx — Python control flow / host casts on traced values.
+
+Inside a jit-context function every jnp-derived value is a tracer; `if`,
+`while`, `bool()`, `int()`, `float()` and `.item()` on one either raises
+TracerBoolConversionError at trace time or — worse, on paths the tests
+never trace — silently bakes one branch into the compiled program. The
+spec lift rewrites these as jnp.where / lax.cond / lax.fori_loop
+(models/phase0/epoch_soa.py is the house style).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, register_pass, register_rule
+from .. import jitmap
+
+register_rule(
+    "CSA101",
+    "Python control flow on a traced value inside a jitted function",
+    "error",
+    "branch with jnp.where / jax.lax.cond, loop with jax.lax.fori_loop "
+    "or jax.lax.while_loop",
+)
+register_rule(
+    "CSA102",
+    "host cast (bool/int/float/.item) of a traced value inside a jitted "
+    "function",
+    "error",
+    "keep the value on device; cast only after jax.device_get outside "
+    "the traced program",
+)
+
+_CASTS = {"bool", "int", "float"}
+
+
+def _test_of(node: ast.AST):
+    if isinstance(node, (ast.If, ast.While)):
+        return node.test
+    return None
+
+
+@register_pass
+def run(mod):
+    findings = []
+    for jf, taint in jitmap.iter_jit_functions(mod.jit_map):
+        for node in jitmap.own_nodes(jf.node):
+            test = _test_of(node)
+            if test is not None and taint.expr_tainted(test):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                names = sorted(n for n in jitmap._expr_names(test)
+                               if n in taint.tainted)
+                findings.append(Finding(
+                    "CSA101", mod.path, node.lineno,
+                    f"`{kind}` on traced value(s) {', '.join(names)} "
+                    f"in jitted `{jf.qualname}`",
+                    context=jf.qualname))
+            elif isinstance(node, ast.Call):
+                fname = jitmap._dotted(node.func)
+                if fname in _CASTS and node.args and \
+                        taint.expr_tainted(node.args[0]):
+                    findings.append(Finding(
+                        "CSA102", mod.path, node.lineno,
+                        f"`{fname}()` applied to a traced value in "
+                        f"jitted `{jf.qualname}`",
+                        context=jf.qualname))
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "item" and not node.args and \
+                        taint.expr_tainted(node.func.value):
+                    findings.append(Finding(
+                        "CSA102", mod.path, node.lineno,
+                        f"`.item()` on a traced value in jitted "
+                        f"`{jf.qualname}`",
+                        context=jf.qualname))
+    return findings
