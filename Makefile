@@ -49,13 +49,18 @@ lint:
 	$(PYTHON) tools/lint.py consensus_specs_tpu tests bench.py __graft_entry__.py tools
 
 # Trace-safety / spec-conformance static analysis (tools/analysis/README.md):
-# five AST passes over the jit surface — Python control flow on tracers,
-# 32-bit truncation of uint64 math, impure traced code, state-aliasing
-# overrides, jit-cache hygiene. Exit 0 = no findings beyond the committed
-# baseline + inline `# csa: ignore[...]` suppressions.
+# eight pass families over the call-graph IR — Python control flow on
+# tracers, 32-bit truncation of uint64 math, impure traced code,
+# state-aliasing overrides, jit-cache hygiene, sharding/collective axis
+# consistency, pallas BlockSpec/grid/Ref contracts, and spec drift vs the
+# reference pyspec (REFERENCE_ROOT, skips with a notice when absent).
+# Exit 0 = no findings beyond the committed baseline + inline
+# `# csa: ignore[...]` suppressions. JSON artifact: out/analysis.json.
+REFERENCE_ROOT ?= /root/reference
 analyze:
 	$(PYTHON) -m tools.analysis consensus_specs_tpu bench.py __graft_entry__.py \
-		--baseline tools/analysis/baseline.json --json out/analysis.json
+		--baseline tools/analysis/baseline.json --json out/analysis.json \
+		--reference-root $(REFERENCE_ROOT)
 
 # Conformance vectors, both presets (reference: make gen_yaml_tests).
 vectors:
@@ -82,9 +87,11 @@ multichip:
 # Quick health check: lint + static analysis + the fast test modules.
 smoke:
 	$(PYTHON) tools/lint.py consensus_specs_tpu tests bench.py __graft_entry__.py tools
+	$(PYTHON) -m tools.analysis --list-rules >/dev/null
 	$(PYTHON) -m tools.analysis consensus_specs_tpu bench.py __graft_entry__.py \
-		--baseline tools/analysis/baseline.json
-	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py -q
+		--baseline tools/analysis/baseline.json \
+		--reference-root $(REFERENCE_ROOT)
+	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py -q
 
 clean:
 	rm -rf out .pytest_cache $(VECTOR_DIR)

@@ -599,32 +599,61 @@ def _progress(msg):
 
 
 _T_START = time.perf_counter()
+_CPU_FALLBACK = False   # set when the probe demoted a dead TPU run to CPU
 
 
 def _probe_backend(timeout_s: int = 180) -> None:
-    """Fail fast (exit 2) when the device backend is unreachable.
+    """Probe the device backend in a subprocess with a hard timeout; on
+    a dead/wedged accelerator, fall back to the CPU smoke path.
 
     A wedged TPU relay hangs `jax.devices()` indefinitely inside
-    uninterruptible native code; probing in a subprocess with a timeout
-    converts a 40-minute silent hang into a quick, diagnosable failure the
-    retry loop can act on."""
+    uninterruptible native code; probing in a subprocess converts a
+    40-minute silent hang into a quick, diagnosable signal. BENCH_r05
+    then exited 2 on that signal and produced no JSON at all — now the
+    probe demotes the run to the CPU smoke configuration (the same path
+    `make bench-cpu` pins) so `make bench` always emits a parseable
+    artifact; only an unreachable CPU backend (interpreter/numpy broken)
+    still aborts."""
     import subprocess
     import sys
-    code = "import jax; print(jax.devices()[0].platform)"
-    if os.environ.get("CSTPU_BENCH_CPU") == "1":
-        code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
-                + code)
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, timeout=timeout_s, text=True)
+
+    def probe(force_cpu: bool) -> str:
+        code = "import jax; print(jax.devices()[0].platform)"
+        if force_cpu:
+            code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+                    + code)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, timeout=timeout_s, text=True)
+        except subprocess.TimeoutExpired:
+            return f"probe hung > {timeout_s}s (relay wedged?)"
         if proc.returncode == 0:
             _progress(f"backend up: {proc.stdout.strip()}")
-            return
+            return ""
         reason = (proc.stderr or "").strip().splitlines()[-1:] or ["unknown"]
-        _progress(f"backend init failed: {reason[0]}")
-    except subprocess.TimeoutExpired:
-        _progress(f"backend probe hung > {timeout_s}s (relay wedged?)")
+        return f"init failed: {reason[0]}"
+
+    cpu_only = os.environ.get("CSTPU_BENCH_CPU") == "1"
+    failure = probe(force_cpu=cpu_only)
+    if not failure:
+        return
+    if not cpu_only:
+        _progress(f"backend {failure} — falling back to the CPU smoke path")
+        failure = probe(force_cpu=True)
+        if not failure:
+            # the scale/pin knobs were read at import; rebind them to the
+            # `make bench-cpu` smoke shape so the run finishes in minutes
+            global V_DEVICE, V_STATE, N_ATTESTATIONS, _CPU_FALLBACK
+            _CPU_FALLBACK = True
+            os.environ["CSTPU_BENCH_CPU"] = "1"   # for child processes
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            V_DEVICE = min(V_DEVICE, 65536)
+            V_STATE = min(V_STATE, V_DEVICE)
+            N_ATTESTATIONS = min(N_ATTESTATIONS, 32)
+            return
+    _progress(f"CPU backend {failure} — nothing to fall back to")
     sys.exit(2)
 
 
@@ -765,6 +794,9 @@ def main():
     if device_error is not None:
         parts.append("device lost mid-run (%s) — later stages missing"
                      % device_error)
+    if _CPU_FALLBACK:
+        parts.append("CPU smoke fallback — accelerator probe failed, "
+                     "numbers are not TPU-comparable")
     parts.append("python baseline %.0f ms scaled over the measured stages"
                  % py_total_ms)
     print(json.dumps({

@@ -181,7 +181,10 @@ def _g2_decompress_traced(x_raw, a_flag):
     from . import fq_tower as T
 
     # deliberate: idempotent trace-time memo of a pure host constant
-    # (same value every trace), read only as a compile-time unroll bound
+    # (same value every trace), read only as a compile-time unroll bound.
+    # Re-reviewed under the interprocedural pass: every cross-module
+    # caller reaches this def through the same jit context, so the memo
+    # still fills exactly once per process regardless of entry path.
     global _SQRT2_EXP_BITS  # csa: ignore[CSA302]
     if _SQRT2_EXP_BITS is None:
         _SQRT2_EXP_BITS = F._exp_bits((gt.q ** 2 + 7) // 16)

@@ -1,0 +1,97 @@
+"""parallel/sharding.py unit coverage on the virtual 8-device CPU mesh.
+
+Direct tests for the placement helpers that previously only ran inside
+the multichip dry-run: mesh construction, leading-axis round trips
+(values must be bitwise-unchanged by placement), hierarchical mesh
+shapes, and the unequal-tree detector the dry-run relies on for its
+bitwise verdicts.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from consensus_specs_tpu.parallel.sharding import (
+    hierarchical_mesh, shard_hierarchical, shard_leading_axis,
+    trees_bitwise_equal, validator_mesh)
+
+
+def _tree():
+    return {
+        "cols": jnp.arange(64, dtype=jnp.uint64).reshape(8, 8),
+        "flat": jnp.arange(16, dtype=jnp.uint32),
+        "scalar": jnp.uint64(7),
+    }
+
+
+def test_validator_mesh_uses_all_devices():
+    mesh = validator_mesh()
+    assert mesh.axis_names == ("v",)
+    assert mesh.devices.shape == (len(jax.devices()),)
+
+
+def test_validator_mesh_subset_and_overask():
+    assert validator_mesh(n=4).devices.shape == (4,)
+    with pytest.raises(AssertionError):
+        validator_mesh(n=len(jax.devices()) + 1)
+
+
+def test_shard_leading_axis_roundtrip_bitwise():
+    mesh = validator_mesh()
+    tree = _tree()
+    sharded = shard_leading_axis(mesh, tree)
+    # placement must not change a single bit
+    assert trees_bitwise_equal(tree, sharded)
+    # array leaves shard their leading axis over "v"
+    assert sharded["cols"].sharding == NamedSharding(mesh, P("v"))
+    assert sharded["flat"].sharding == NamedSharding(mesh, P("v"))
+    # 0-d leaves replicate
+    assert sharded["scalar"].sharding == NamedSharding(mesh, P())
+    # every device owns a distinct shard of the leading axis
+    devs = {s.device for s in sharded["cols"].addressable_shards}
+    assert len(devs) == len(jax.devices())
+
+
+def test_hierarchical_mesh_shapes():
+    assert hierarchical_mesh(hosts=2).devices.shape == (2, 4)
+    assert hierarchical_mesh(hosts=4).devices.shape == (4, 2)
+    assert hierarchical_mesh(hosts=2).axis_names == ("host", "v")
+    with pytest.raises(AssertionError):
+        hierarchical_mesh(hosts=3)   # 8 devices don't tile 3 hosts
+
+
+def test_shard_hierarchical_roundtrip_bitwise():
+    mesh = hierarchical_mesh(hosts=2)
+    tree = _tree()
+    sharded = shard_hierarchical(mesh, tree)
+    assert trees_bitwise_equal(tree, sharded)
+    # flattened (host, v) product: all 8 devices own leading-axis shards
+    assert sharded["cols"].sharding == NamedSharding(mesh, P(("host", "v")))
+    devs = {s.device for s in sharded["cols"].addressable_shards}
+    assert len(devs) == len(jax.devices())
+
+
+def test_trees_bitwise_equal_detects_value_drift():
+    a = _tree()
+    b = _tree()
+    assert trees_bitwise_equal(a, b)
+    b["flat"] = b["flat"].at[3].set(99)
+    assert not trees_bitwise_equal(a, b)
+
+
+def test_trees_bitwise_equal_detects_dtype_shape_and_arity():
+    a = _tree()
+    narrower = dict(a, cols=a["cols"].astype(jnp.uint32))
+    assert not trees_bitwise_equal(a, narrower)
+    reshaped = dict(a, cols=a["cols"].reshape(4, 16))
+    assert not trees_bitwise_equal(a, reshaped)
+    pruned = {k: v for k, v in a.items() if k != "scalar"}
+    assert not trees_bitwise_equal(a, pruned)
+
+
+def test_trees_bitwise_equal_mixed_host_device_leaves():
+    # host compare: numpy and device arrays with identical bits are equal
+    a = {"x": np.arange(8, dtype=np.uint64)}
+    b = {"x": jnp.arange(8, dtype=jnp.uint64)}
+    assert trees_bitwise_equal(a, b)

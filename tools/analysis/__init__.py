@@ -11,9 +11,18 @@ and small states) and tools/lint.py (syntax/style only):
   CSA3xx  purity          host side effects baked into traced programs
   CSA4xx  state-aliasing  `state` parameters a body never consults
   CSA5xx  jit-cache       retrace storms and unhashable static arguments
+  CSA6xx  sharding        collective/PartitionSpec axes vs declared meshes
+  CSA7xx  pallas          BlockSpec/grid/Ref contracts of pallas_call
+  CSA8xx  spec-drift      constants + signatures vs the reference pyspec
+
+The per-module passes run over each file's jit context; trace context
+propagates across module boundaries through the call-graph IR
+(callgraph.py), and program-level passes (CSA6xx, CSA8xx) run once over
+the whole-program view.
 
 Entry points:
   python -m tools.analysis <targets> [--json out.json] [--baseline b.json]
+                                     [--reference-root DIR]
   make analyze
 
 See tools/analysis/README.md for the rule catalog and suppression syntax

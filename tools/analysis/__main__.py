@@ -29,6 +29,11 @@ def main(argv=None) -> int:
                         help="rewrite --baseline from current findings")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
+    parser.add_argument("--reference-root", metavar="DIR",
+                        help="reference pyspec tree for the CSA8xx "
+                             "spec-drift pass (default: "
+                             "$CSTPU_REFERENCE_ROOT or /root/reference; "
+                             "the pass skips with a notice when absent)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -39,8 +44,11 @@ def main(argv=None) -> int:
         parser.print_usage(sys.stderr)
         return 2
 
+    options = {}
+    if args.reference_root:
+        options["reference_root"] = args.reference_root
     baseline = load_baseline(args.baseline)
-    report = analyze_paths(args.targets, baseline)
+    report = analyze_paths(args.targets, baseline, options)
 
     if args.update_baseline:
         if not args.baseline:

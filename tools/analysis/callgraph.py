@@ -1,0 +1,281 @@
+"""Whole-program IR: module graph + call graph + cross-module jit taint.
+
+PR 1's jitmap discovers jit context per file; its transitive-callee step
+stops at the module edge, so a helper imported from another module and
+called inside a jitted body was analyzed as host code. This module lifts
+the same taint model to the program level:
+
+  1. every target file gets a dotted module name. Package roots are
+     detected by ``__init__.py`` — a directory target that is itself a
+     package keeps its name as the prefix (``consensus_specs_tpu.ops.
+     sha256``), a plain directory of fixtures roots names at the
+     directory (``pkg.a`` for ``<tmpdir>/pkg/a.py``), a single-file
+     target is just its stem (``bench``);
+  2. imports are resolved to program modules: ``import a.b [as c]``,
+     ``from a.b import f [as g]``, ``from pkg import mod``, and
+     relative ``from ..models.phase0.epoch_soa import X`` forms;
+  3. jit context propagates along resolved call edges until fixpoint: a
+     def in module B called (by from-imported name, or as an attribute
+     of an imported module object) from any jit-context function in
+     module A becomes jit context in B's JitMap, with the same
+     annotation-driven parameter classification jitmap applies to
+     same-module transitive callees — so every existing per-module pass
+     sees it with no changes of its own;
+  4. jitted *names* propagate too: ``from ops.x import f_jit`` makes
+     call sites of ``f_jit`` in the importing module visible to the
+     CSA5xx cache-hygiene pass.
+
+The Program object also carries the analysis options (the spec-drift
+reference root) and the notices program-level passes emit (e.g. the
+CSA8xx skip notice when the reference tree is absent).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import jitmap
+
+
+@dataclass
+class ModuleNode:
+    name: str                       # dotted module name
+    info: object                    # core.ModuleInfo
+    is_init: bool = False
+    # local name -> dotted module ("import a.b as c", "import a.b")
+    module_aliases: Dict[str, str] = field(default_factory=dict)
+    # local name -> (dotted source module, remote name)
+    from_imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    # module-level function defs by name
+    defs: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+
+    @property
+    def package(self) -> List[str]:
+        parts = self.name.split(".")
+        return parts if self.is_init else parts[:-1]
+
+
+@dataclass
+class Program:
+    modules: Dict[str, ModuleNode] = field(default_factory=dict)
+    by_path: Dict[str, ModuleNode] = field(default_factory=dict)
+    # (caller module, caller def) -> {(callee module, callee def)}
+    edges: Dict[Tuple[str, str], Set[Tuple[str, str]]] = \
+        field(default_factory=dict)
+    options: Dict[str, object] = field(default_factory=dict)
+    notices: List[str] = field(default_factory=list)
+    # rule ids whose pass did not run this invocation (e.g. CSA8xx when
+    # the reference tree is absent): their baseline entries are exempt
+    # from staleness, or a deliberate-divergence entry recorded where
+    # the reference exists would fail the ratchet on machines without it
+    skipped_rules: Set[str] = field(default_factory=set)
+
+    def module_named(self, suffix: str) -> Optional[ModuleNode]:
+        """The first module whose dotted name equals or ends with
+        `suffix` (used by passes to anchor program-level findings)."""
+        for name, node in sorted(self.modules.items()):
+            if name == suffix or name.endswith("." + suffix):
+                return node
+        return None
+
+
+def module_name_for(path: Path, root: Path) -> str:
+    rel = path.resolve().relative_to(root.resolve())
+    parts = list(rel.with_suffix("").parts)
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def root_for_target(target: Path) -> Path:
+    """The import root a target's module names are computed against."""
+    if target.is_dir():
+        # a dir that IS a package keeps its own name as the prefix
+        return target.parent if (target / "__init__.py").exists() else target
+    return target.parent
+
+
+def _parse_imports(node: ModuleNode) -> None:
+    pkg = node.package
+    for stmt in ast.walk(node.info.tree):
+        if isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                local = alias.asname or alias.name
+                node.module_aliases[local] = alias.name
+        elif isinstance(stmt, ast.ImportFrom):
+            if stmt.level == 0:
+                base = (stmt.module or "").split(".")
+            else:
+                # `from .` = the module's package; each extra dot climbs
+                keep = len(pkg) - (stmt.level - 1)
+                if keep < 0:
+                    continue
+                base = pkg[:keep] if stmt.level > 1 else list(pkg)
+                if stmt.module:
+                    base = base + stmt.module.split(".")
+            src = ".".join(p for p in base if p)
+            for alias in stmt.names:
+                local = alias.asname or alias.name
+                node.from_imports[local] = (src, alias.name)
+
+
+def resolve_module(node: ModuleNode, dotted: str,
+                   program: Program) -> Optional[ModuleNode]:
+    """The program module a dotted *value* expression refers to, if any:
+    an import alias, a from-imported submodule, or a full module path."""
+    if not dotted:
+        return None
+    if dotted in node.module_aliases:
+        return program.modules.get(node.module_aliases[dotted])
+    fi = node.from_imports.get(dotted)
+    if fi is not None:
+        src, remote = fi
+        return program.modules.get(f"{src}.{remote}" if src else remote)
+    return program.modules.get(dotted)
+
+
+def resolve_call(node: ModuleNode, call: ast.Call, program: Program
+                 ) -> Optional[Tuple[ModuleNode, Optional[ast.FunctionDef]]]:
+    """(defining module, FunctionDef|None) for a call that resolves to a
+    program module's module-level def; None for anything else (methods,
+    builtins, third-party calls)."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        fi = node.from_imports.get(func.id)
+        if fi is not None:
+            src_mod = program.modules.get(fi[0])
+            if src_mod is not None and fi[1] in src_mod.defs:
+                return src_mod, src_mod.defs[fi[1]]
+            return None
+        if func.id in node.defs:
+            return node, node.defs[func.id]
+        return None
+    if isinstance(func, ast.Attribute):
+        base = jitmap._dotted(func.value)
+        target = resolve_module(node, base, program)
+        if target is not None:
+            return target, target.defs.get(func.attr)
+    return None
+
+
+def _propagate_jit(program: Program) -> None:
+    """Extend each module's JitMap with cross-module transitive callees
+    (and imported jitted names) until fixpoint."""
+    work: List[Tuple[ModuleNode, ast.AST]] = []
+    for node in program.modules.values():
+        jmap = node.info.jit_map          # forces the per-module build
+        work.extend((node, jf.node) for jf in list(jmap.funcs.values()))
+    seen = {id(fn) for _, fn in work}
+    while work:
+        node, fn = work.pop()
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            resolved = resolve_call(node, sub, program)
+            if resolved is None:
+                continue
+            t_node, t_def = resolved
+            if t_def is None:
+                continue
+            t_jmap = t_node.info.jit_map
+            if t_def not in t_jmap.funcs:
+                static, traced = jitmap._callee_params(t_def)
+                t_jmap.funcs[t_def] = jitmap.JitFunc(
+                    t_def, t_def.name, direct=False,
+                    traced_params=traced, static_params=static)
+            if id(t_def) not in seen:
+                seen.add(id(t_def))
+                work.append((t_node, t_def))
+
+    # imported jitted names: make `from m import f_jit` call sites
+    # visible to the importing module's CSA5xx checks. To fixpoint —
+    # re-export chains (a defines, b re-exports, c calls) must resolve
+    # regardless of module iteration order.
+    changed = True
+    while changed:
+        changed = False
+        for node in program.modules.values():
+            for local, (src, remote) in node.from_imports.items():
+                src_mod = program.modules.get(src)
+                if src_mod is None:
+                    continue
+                jitted = src_mod.info.jit_map.jitted_names
+                if remote in jitted and \
+                        local not in node.info.jit_map.jitted_names:
+                    node.info.jit_map.jitted_names[local] = jitted[remote]
+                    changed = True
+
+
+def _build_edges(program: Program) -> None:
+    for node in program.modules.values():
+        for name, fn in node.defs.items():
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                resolved = resolve_call(node, sub, program)
+                if resolved is None or resolved[1] is None:
+                    continue
+                t_node, t_def = resolved
+                program.edges.setdefault((node.name, name), set()).add(
+                    (t_node.name, t_def.name))
+
+
+def build(rooted_modules: List[Tuple[Path, object]],
+          options: Optional[Dict[str, object]] = None) -> Program:
+    """`rooted_modules`: (import root, core.ModuleInfo) pairs."""
+    program = Program(options=dict(options or {}))
+    for root, info in rooted_modules:
+        name = module_name_for(Path(info.path), root)
+        is_init = Path(info.path).name == "__init__.py"
+        node = ModuleNode(name=name, info=info, is_init=is_init)
+        for stmt in info.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                node.defs[stmt.name] = stmt
+        if name in program.modules:
+            # two targets map to one dotted name (same-stem files from
+            # different roots). Imports resolve to the first; the later
+            # module still gets a distinct key so every program pass
+            # scans it (a silent drop would be order-dependent).
+            program.notices.append(
+                f"callgraph: module name '{name}' is ambiguous "
+                f"({program.modules[name].info.path} vs {info.path}); "
+                f"imports resolve to the first")
+            suffix = 2
+            while f"{name}#{suffix}" in program.modules:
+                suffix += 1
+            name = f"{name}#{suffix}"
+            node.name = name
+        program.modules[name] = node
+        program.by_path[info.path] = node
+    for node in program.modules.values():
+        _parse_imports(node)
+    _build_edges(program)
+    _propagate_jit(program)
+    return program
+
+
+# -- shared helpers for the program-level passes ----------------------------
+
+def enclosing_qualnames(info) -> Dict[int, ast.AST]:
+    """id(node) -> nearest enclosing FunctionDef/ClassDef node, for
+    passes that anchor findings with a scope-qualified context."""
+    out: Dict[int, ast.AST] = {}
+
+    def visit(parent: ast.AST, scope: Optional[ast.AST]):
+        for child in ast.iter_child_nodes(parent):
+            nxt = scope
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                nxt = child
+            if scope is not None:
+                out[id(child)] = scope
+            visit(child, nxt)
+    visit(info.tree, None)
+    return out
+
+
+def context_of(info, enclosing: Dict[int, ast.AST], node: ast.AST) -> str:
+    scope = enclosing.get(id(node))
+    return info.qualname(scope) if scope is not None else ""

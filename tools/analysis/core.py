@@ -43,6 +43,7 @@ class Rule:
 
 RULES: Dict[str, Rule] = {}
 PASSES: List[Callable] = []
+PROGRAM_PASSES: List[Callable] = []
 
 
 def register_rule(rule_id: str, summary: str, severity: str,
@@ -55,7 +56,16 @@ def register_rule(rule_id: str, summary: str, severity: str,
 
 
 def register_pass(fn: Callable) -> Callable:
+    """A per-module pass: `(ModuleInfo) -> Iterable[Finding]`."""
     PASSES.append(fn)
+    return fn
+
+
+def register_program_pass(fn: Callable) -> Callable:
+    """A whole-program pass: `(callgraph.Program) -> Iterable[Finding]`.
+    Runs once after every target module is parsed and the call-graph IR
+    (cross-module jit context) is built."""
+    PROGRAM_PASSES.append(fn)
     return fn
 
 
@@ -149,13 +159,18 @@ def load_module(path: Path) -> Optional[ModuleInfo]:
                       suppressions=_parse_suppressions(source))
 
 
-def iter_py_files(targets: Iterable[str]):
+def iter_py_files_rooted(targets: Iterable[str]):
+    """(import root, file) pairs — the root is what callgraph computes
+    dotted module names against (see callgraph.root_for_target)."""
+    from .callgraph import root_for_target
     for target in targets:
         path = Path(target)
         if path.is_dir():
-            yield from sorted(path.rglob("*.py"))
+            root = root_for_target(path)
+            for sub in sorted(path.rglob("*.py")):
+                yield root, sub
         elif path.suffix == ".py":
-            yield path
+            yield path.parent, path
 
 
 # -- baseline ---------------------------------------------------------------
@@ -203,35 +218,51 @@ class Report:
     baselined: List[Finding]           # matched a baseline entry
     stale_baseline: List[str]          # baseline fingerprints nothing matched
     files_checked: int = 0
+    notices: List[str] = field(default_factory=list)  # program-pass notes
 
 
 def analyze_paths(targets: Iterable[str],
-                  baseline: Optional[Dict[str, str]] = None) -> Report:
+                  baseline: Optional[Dict[str, str]] = None,
+                  options: Optional[Dict[str, object]] = None) -> Report:
+    from . import callgraph
     baseline = baseline or {}
     actionable: List[Finding] = []
     suppressed: List[Finding] = []
     baselined: List[Finding] = []
     matched = set()
-    files = 0
-    for path in iter_py_files(targets):
+    rooted = []
+    for root, path in iter_py_files_rooted(targets):
         mod = load_module(path)
-        if mod is None:
-            continue
-        files += 1
+        if mod is not None:
+            rooted.append((root, mod))
+    program = callgraph.build(rooted, options)
+
+    def classify(finding: Finding, mod: Optional[ModuleInfo]):
+        if mod is not None and mod.suppressed(finding):
+            suppressed.append(finding)
+        elif finding.fingerprint() in baseline:
+            matched.add(finding.fingerprint())
+            baselined.append(finding)
+        else:
+            actionable.append(finding)
+
+    for root, mod in rooted:
         for pass_fn in PASSES:
             for finding in pass_fn(mod):
-                if mod.suppressed(finding):
-                    suppressed.append(finding)
-                elif finding.fingerprint() in baseline:
-                    matched.add(finding.fingerprint())
-                    baselined.append(finding)
-                else:
-                    actionable.append(finding)
-    stale = sorted(set(baseline) - matched)
+                classify(finding, mod)
+    by_path = {mod.path: mod for _, mod in rooted}
+    for pass_fn in PROGRAM_PASSES:
+        for finding in pass_fn(program):
+            classify(finding, by_path.get(finding.path))
+    stale = sorted(
+        fp for fp in set(baseline) - matched
+        # entries for rules whose pass was skipped this run (CSA8xx
+        # without a reference tree) are unverifiable, not stale
+        if fp.split("::")[1] not in program.skipped_rules)
     actionable.sort(key=lambda f: (f.path, f.line, f.rule))
     return Report(findings=actionable, suppressed=suppressed,
                   baselined=baselined, stale_baseline=stale,
-                  files_checked=files)
+                  files_checked=len(rooted), notices=list(program.notices))
 
 
 # -- reporters --------------------------------------------------------------
@@ -244,6 +275,8 @@ def render_human(report: Report) -> str:
             out.append(f"    hint: {f.hint}")
     for fp in report.stale_baseline:
         out.append(f"baseline: stale entry (fixed? delete it): {fp}")
+    for note in report.notices:
+        out.append(f"notice: {note}")
     out.append(f"analysis: {report.files_checked} files, "
                f"{len(report.findings)} finding(s), "
                f"{len(report.suppressed)} suppressed, "
@@ -263,5 +296,6 @@ def render_json(report: Report) -> str:
         "suppressed": [row(f) for f in report.suppressed],
         "baselined": [row(f) for f in report.baselined],
         "stale_baseline": report.stale_baseline,
+        "notices": report.notices,
         "files_checked": report.files_checked,
     }, indent=2)

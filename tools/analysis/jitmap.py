@@ -132,7 +132,13 @@ def _annotation_is_host(ann: Optional[ast.AST]) -> bool:
     if isinstance(ann, ast.Subscript):   # List[int], Optional[bytes], ...
         ann = ann.value
     name = _dotted(ann)
-    base = name.split(".")[-1]
+    parts = name.split(".")
+    if parts[0] in ("np", "numpy"):
+        # np.ndarray params are host-side trace-time constants in this
+        # codebase (jnp.ndarray is the traced annotation) — e.g. the
+        # static int matrices fq_tower unrolls at trace time
+        return True
+    base = parts[-1]
     return base in _HOST_ANNOTATIONS or base.endswith("Config")
 
 
@@ -317,6 +323,11 @@ class Taint:
             # conservative for same-module numeric helpers
             return any(self._tainted(a) for a in node.args) or \
                 any(self._tainted(k.value) for k in node.keywords)
+        if isinstance(node, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            # `x is None` is an object-identity check: a host bool even
+            # when x holds a tracer (never calls the tracer's __bool__)
+            return False
         if isinstance(node, (ast.BinOp, ast.BoolOp, ast.Compare,
                              ast.UnaryOp, ast.Subscript, ast.IfExp,
                              ast.Tuple, ast.List, ast.Starred,

@@ -1,6 +1,11 @@
-"""Importing this package registers every analysis pass with core.PASSES."""
+"""Importing this package registers every analysis pass with core.PASSES
+(per-module) or core.PROGRAM_PASSES (whole-program, over the call-graph
+IR in tools/analysis/callgraph.py)."""
 from . import trace_safety  # noqa: F401
 from . import dtype_width   # noqa: F401
 from . import purity        # noqa: F401
 from . import state_aliasing  # noqa: F401
 from . import jit_cache     # noqa: F401
+from . import sharding_collective  # noqa: F401
+from . import pallas_kernels  # noqa: F401
+from . import spec_drift    # noqa: F401

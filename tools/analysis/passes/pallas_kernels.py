@@ -1,0 +1,276 @@
+"""CSA7xx — Pallas kernel call constraints.
+
+A `pl.pallas_call` is a contract in three parts: the grid, the
+BlockSpecs (block shape + index map), and the kernel's Ref parameters.
+Nothing checks the parts against each other until Mosaic lowering on a
+real TPU — and the CPU test path runs `interpret=True`, which validates
+much less. These checks are pure arithmetic over the AST:
+
+  CSA701  BlockSpec index-map arity must equal the grid rank, and the
+          index tuple it returns must match the block shape's rank
+  CSA702  `grid` / `block_shape` entries must be static (a traced value
+          there fails at trace time on the first real-TPU run)
+  CSA703  a module with pallas_call sites but no `interpret=` escape
+          hatch anywhere cannot run its kernels on CPU at all — the
+          fixture/test path silently loses coverage
+  CSA704  a constant Ref index outside the declared block shape (or a
+          subscript of higher rank than the block) reads/writes out of
+          the tile the BlockSpec actually maps in
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding, register_pass, register_rule
+from .. import jitmap
+from ..callgraph import enclosing_qualnames
+
+register_rule(
+    "CSA701",
+    "BlockSpec index-map arity or index rank disagrees with grid/block "
+    "shape",
+    "error",
+    "the index map takes one argument per grid dimension and returns "
+    "one block index per block_shape dimension",
+)
+register_rule(
+    "CSA702",
+    "traced value in pallas_call grid or BlockSpec block_shape",
+    "error",
+    "grid and block shapes are compile-time constants; derive them from "
+    "`.shape` (static under trace) or pass them as static_argnums",
+)
+register_rule(
+    "CSA703",
+    "pallas_call sites with no interpret= escape hatch in the module",
+    "warning",
+    "Mosaic lowering is TPU-only; thread an `interpret=` flag through "
+    "at least one call path so the kernel runs (and is tested) on CPU",
+)
+register_rule(
+    "CSA704",
+    "Ref indexed outside the BlockSpec's declared block",
+    "error",
+    "each grid step owns exactly the block_shape tile its index map "
+    "selects; constant indices must stay inside it",
+)
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _const_int(node.operand)
+        return -inner if inner is not None else None
+    return None
+
+
+def _tuple_elts(node: Optional[ast.AST]) -> Optional[List[ast.AST]]:
+    """Elements of a literal tuple/list; a bare expr is a 1-tuple."""
+    if node is None:
+        return None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return list(node.elts)
+    return [node]
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class _BlockSpec:
+    """Statically-known facts about one BlockSpec expression."""
+
+    def __init__(self, call: ast.Call):
+        self.call = call
+        shape_expr = call.args[0] if call.args else _kwarg(call,
+                                                           "block_shape")
+        self.shape_elts = _tuple_elts(shape_expr)
+        self.dims: Optional[List[Optional[int]]] = None
+        if self.shape_elts is not None:
+            self.dims = [_const_int(e) for e in self.shape_elts]
+        index_map = call.args[1] if len(call.args) > 1 else \
+            _kwarg(call, "index_map")
+        self.index_map = index_map if isinstance(index_map,
+                                                 ast.Lambda) else None
+
+
+def _resolve_blockspec(node: ast.AST,
+                       assigns: Dict[str, ast.AST]) -> Optional[_BlockSpec]:
+    if isinstance(node, ast.Name):
+        node = assigns.get(node.id, node)
+    if isinstance(node, ast.Call) and \
+            jitmap._dotted(node.func).split(".")[-1] == "BlockSpec":
+        return _BlockSpec(node)
+    return None
+
+
+def _spec_list(node: Optional[ast.AST],
+               assigns: Dict[str, ast.AST]) -> List[Optional[_BlockSpec]]:
+    if node is None:
+        return []
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    return [_resolve_blockspec(e, assigns) for e in elts]
+
+
+@register_pass
+def run(mod) -> List[Finding]:
+    findings: List[Finding] = []
+    tree = mod.tree
+
+    # name -> assigned value, SCOPED: module-level assigns overlaid with
+    # the enclosing function's own assigns (two functions reusing the
+    # name `spec` for different BlockSpecs must not see each other's)
+    def _scope_assigns(nodes) -> Dict[str, ast.AST]:
+        out: Dict[str, ast.AST] = {}
+        for node in nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                out[node.targets[0].id] = node.value
+        return out
+
+    module_assigns = _scope_assigns(
+        n for stmt in tree.body for n in ast.walk(stmt)
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)))
+    enclosing = enclosing_qualnames(mod)
+    _fn_assigns: Dict[int, Dict[str, ast.AST]] = {}
+
+    def assigns_for(node: ast.AST) -> Dict[str, ast.AST]:
+        scope = enclosing.get(id(node))
+        while scope is not None and not isinstance(
+                scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope = enclosing.get(id(scope))
+        if scope is None:
+            return module_assigns
+        if id(scope) not in _fn_assigns:
+            local = _scope_assigns(jitmap.own_nodes(scope))
+            _fn_assigns[id(scope)] = {**module_assigns, **local}
+        return _fn_assigns[id(scope)]
+
+    all_defs: Dict[str, ast.FunctionDef] = {
+        n.name: n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    calls: List[Tuple[ast.Call, str]] = []   # (pallas_call node, context)
+    # jit-context taint for CSA702 (pallas_call usually sits inside a
+    # jitted wrapper; traced grid/block entries are what we hunt)
+    taint_of: Dict[int, object] = {}
+    ctx_of: Dict[int, str] = {}
+    for jf, taint in jitmap.iter_jit_functions(mod.jit_map):
+        for node in jitmap.own_nodes(jf.node):
+            if isinstance(node, ast.Call) and \
+                    jitmap._dotted(node.func).split(".")[-1] == "pallas_call":
+                taint_of[id(node)] = taint
+                ctx_of[id(node)] = jf.qualname
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                jitmap._dotted(node.func).split(".")[-1] == "pallas_call":
+            calls.append((node, ctx_of.get(id(node), "")))
+
+    has_interpret = any(_kwarg(c, "interpret") is not None
+                        for c, _ in calls)
+    if calls and not has_interpret:
+        first = min(c.lineno for c, _ in calls)
+        findings.append(Finding(
+            "CSA703", mod.path, first,
+            f"{len(calls)} pallas_call site(s) and none takes an "
+            f"`interpret=` flag — kernels cannot run off-TPU",
+            context="module"))
+
+    for call, ctx in calls:
+        assigns = assigns_for(call)
+        grid_expr = _kwarg(call, "grid")
+        if isinstance(grid_expr, ast.Name):     # grid = (...) then grid=grid
+            grid_expr = assigns.get(grid_expr.id)
+        grid_elts = _tuple_elts(grid_expr) if isinstance(
+            grid_expr, (ast.Tuple, ast.List, ast.Constant,
+                        ast.BinOp)) else None
+        grid_rank = len(grid_elts) if grid_elts is not None else None
+
+        in_specs = _spec_list(_kwarg(call, "in_specs"), assigns)
+        out_specs = _spec_list(_kwarg(call, "out_specs"), assigns)
+        specs = in_specs + out_specs
+
+        taint = taint_of.get(id(call))
+        if taint is not None and grid_elts:
+            for e in grid_elts:
+                if taint.expr_tainted(e):
+                    findings.append(Finding(
+                        "CSA702", mod.path, call.lineno,
+                        f"traced value `{ast.unparse(e)}` in pallas_call "
+                        f"grid",
+                        context=ctx))
+
+        for spec in specs:
+            if spec is None:
+                continue
+            if taint is not None and spec.shape_elts:
+                for e in spec.shape_elts:
+                    if taint.expr_tainted(e):
+                        findings.append(Finding(
+                            "CSA702", mod.path, spec.call.lineno,
+                            f"traced value `{ast.unparse(e)}` in "
+                            f"BlockSpec block_shape",
+                            context=ctx))
+            if spec.index_map is not None:
+                arity = len(spec.index_map.args.args)
+                if grid_rank is not None and arity != grid_rank:
+                    findings.append(Finding(
+                        "CSA701", mod.path, spec.call.lineno,
+                        f"BlockSpec index map takes {arity} arg(s) but "
+                        f"the grid has rank {grid_rank}",
+                        context=ctx))
+                ret = _tuple_elts(spec.index_map.body)
+                if ret is not None and spec.dims is not None and \
+                        len(ret) != len(spec.dims):
+                    findings.append(Finding(
+                        "CSA701", mod.path, spec.call.lineno,
+                        f"BlockSpec index map returns {len(ret)} "
+                        f"index(es) for a rank-{len(spec.dims)} block",
+                        context=ctx))
+
+        # CSA704: map kernel ref params to block shapes
+        kernel = call.args[0] if call.args else None
+        fndef = all_defs.get(jitmap._dotted(kernel)) \
+            if kernel is not None else None
+        if fndef is None:
+            continue
+        params = [a.arg for a in fndef.args.posonlyargs + fndef.args.args]
+        if len(params) != len(specs) or not specs:
+            continue   # scalar-prefetch / scratch shapes: out of scope
+        dims_of = {p: s.dims for p, s in zip(params, specs)
+                   if s is not None and s.dims is not None}
+        for sub in ast.walk(fndef):
+            if not isinstance(sub, ast.Subscript) or \
+                    not isinstance(sub.value, ast.Name):
+                continue
+            dims = dims_of.get(sub.value.id)
+            if dims is None:
+                continue
+            idx_elts = sub.slice.elts if isinstance(
+                sub.slice, ast.Tuple) else [sub.slice]
+            if len(idx_elts) > len(dims):
+                findings.append(Finding(
+                    "CSA704", mod.path, sub.lineno,
+                    f"`{sub.value.id}` indexed with {len(idx_elts)} "
+                    f"dims but its block is rank {len(dims)}",
+                    context=mod.qualname(fndef)))
+                continue
+            for i, e in enumerate(idx_elts):
+                iv = _const_int(e)
+                if iv is None or dims[i] is None:
+                    continue
+                if not (-dims[i] <= iv < dims[i]):
+                    findings.append(Finding(
+                        "CSA704", mod.path, sub.lineno,
+                        f"`{sub.value.id}` index {iv} is outside its "
+                        f"declared block dim of size {dims[i]}",
+                        context=mod.qualname(fndef)))
+    return findings

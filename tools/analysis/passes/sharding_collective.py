@@ -1,0 +1,261 @@
+"""CSA6xx — sharding / collective consistency (whole-program pass).
+
+The distributed-correctness analogue of the trace-safety family: axis
+names are stringly-typed, so a collective over an axis no mesh declares,
+a PartitionSpec naming a misspelled mesh axis, or a constraint that
+needs an ambient mesh none provides, all pass every single-device test
+and fail (or silently mis-place data) only on real multi-chip hardware.
+This is the same contract SNIPPETS.md §[1] documents for staged pjit —
+one stage's out specs must be the next stage's in specs — checked
+statically at the call-graph level: mesh axis declarations anywhere in
+the program (`Mesh(..., axis_names=...)`, `jax.make_mesh`, `pmap
+(axis_name=...)`) form the program's axis vocabulary, and every
+collective / PartitionSpec / constraint is checked against it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, register_program_pass, register_rule
+from .. import jitmap
+from ..callgraph import Program, context_of, enclosing_qualnames
+
+register_rule(
+    "CSA601",
+    "collective over an axis name no mesh/pmap in the program declares",
+    "error",
+    "bind the axis first: Mesh(..., axis_names=(...)), shard_map over "
+    "that mesh, or pmap(axis_name=...) — collectives over unbound names "
+    "raise NameError-like failures only at lowering time on real devices",
+)
+register_rule(
+    "CSA602",
+    "PartitionSpec names an axis no mesh in the program declares",
+    "error",
+    "PartitionSpec entries must name axes of the mesh the sharding is "
+    "applied under; a misspelled axis places every shard on device 0",
+)
+register_rule(
+    "CSA603",
+    "with_sharding_constraint with a bare PartitionSpec outside any "
+    "visible mesh scope",
+    "warning",
+    "a bare PartitionSpec needs an ambient mesh (`with mesh:`); pass "
+    "NamedSharding(mesh, spec) instead, or move the call under the mesh "
+    "context manager",
+)
+register_rule(
+    "CSA604",
+    "value resharded to a different PartitionSpec than its producer",
+    "warning",
+    "a sharded producer feeding a differently-specced consumer inserts "
+    "a silent all-to-all reshard; make the producer's out spec the "
+    "consumer's in spec (or constrain once at the boundary)",
+)
+
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                "all_to_all", "ppermute", "pshuffle", "psum_scatter",
+                "axis_index"}
+# collectives whose axis name is the FIRST positional argument
+_AXIS_ARG0 = {"axis_index"}
+_MESH_CTORS = {"Mesh", "AbstractMesh", "make_mesh"}
+
+
+def _dotted(node: ast.AST) -> str:
+    return jitmap._dotted(node)
+
+
+def _is_collective(mnode, call: ast.Call) -> Optional[str]:
+    """The collective's name when `call` is a jax.lax collective."""
+    dotted = _dotted(call.func)
+    if not dotted:
+        return None
+    parts = dotted.split(".")
+    last = parts[-1]
+    if last not in _COLLECTIVES:
+        return None
+    if len(parts) > 1:
+        return last if "lax" in parts[:-1] else None
+    src = mnode.from_imports.get(last)
+    if src is not None and src[0].endswith("lax"):
+        return last
+    return None
+
+
+def _axis_arg(name: str, call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    idx = 0 if name in _AXIS_ARG0 else 1
+    if len(call.args) > idx:
+        return call.args[idx]
+    return None
+
+
+def _partition_spec_locals(mnode) -> Set[str]:
+    """Local names bound to jax.sharding.PartitionSpec by from-import."""
+    return {local for local, (src, remote) in mnode.from_imports.items()
+            if remote == "PartitionSpec"}
+
+
+def _is_pspec_call(mnode, p_locals: Set[str], node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = _dotted(node.func)
+    return dotted.split(".")[-1] == "PartitionSpec" or dotted in p_locals
+
+
+def _declared_axes(program: Program) -> Set[str]:
+    axes: Set[str] = set()
+    for mnode in program.modules.values():
+        for node in ast.walk(mnode.info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            last = dotted.split(".")[-1]
+            if last in _MESH_CTORS:
+                target = None
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        target = kw.value
+                if target is None and len(node.args) > 1:
+                    target = node.args[1]
+                if target is not None:
+                    axes.update(jitmap._const_strs(target))
+                    if isinstance(target, ast.Constant) and \
+                            isinstance(target.value, str):
+                        axes.add(target.value)
+            elif last in ("pmap", "shard_map", "smap"):
+                for kw in node.keywords:
+                    if kw.arg in ("axis_name", "axis_names"):
+                        axes.update(jitmap._const_strs(kw.value))
+    return axes
+
+
+def _spec_key(node: ast.AST) -> str:
+    """Canonical text of a sharding expression for CSA604 comparison."""
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        return ast.dump(node)
+
+
+def _inner_pspec(mnode, p_locals: Set[str], node: ast.AST
+                 ) -> Optional[ast.Call]:
+    """The PartitionSpec(...) call inside a sharding expression, if it
+    appears literally (NamedSharding(mesh, P(...)) or bare P(...))."""
+    for sub in ast.walk(node):
+        if _is_pspec_call(mnode, p_locals, sub):
+            return sub
+    return None
+
+
+@register_program_pass
+def run(program: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    axes = _declared_axes(program)
+    for mnode in program.modules.values():
+        info = mnode.info
+        p_locals = _partition_spec_locals(mnode)
+        enclosing = enclosing_qualnames(info)
+        parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(info.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[id(child)] = parent
+
+        def in_mesh_scope(node: ast.AST) -> bool:
+            cur = node
+            while id(cur) in parents:
+                cur = parents[id(cur)]
+                if isinstance(cur, ast.With):
+                    for item in cur.items:
+                        if "mesh" in _spec_key(item.context_expr).lower():
+                            return True
+            return False
+
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            ctx = context_of(info, enclosing, node)
+
+            coll = _is_collective(mnode, node)
+            if coll is not None:
+                axis_expr = _axis_arg(coll, node)
+                for name in (jitmap._const_strs(axis_expr)
+                             if axis_expr is not None else []):
+                    if name not in axes:
+                        findings.append(Finding(
+                            "CSA601", info.path, node.lineno,
+                            f"collective `{coll}` over axis '{name}' "
+                            f"which no Mesh/pmap in the program declares",
+                            context=ctx))
+
+            if _is_pspec_call(mnode, p_locals, node):
+                for name in jitmap._const_strs(ast.Tuple(
+                        elts=list(node.args), ctx=ast.Load())):
+                    if name not in axes:
+                        findings.append(Finding(
+                            "CSA602", info.path, node.lineno,
+                            f"PartitionSpec axis '{name}' is not an axis "
+                            f"of any declared mesh",
+                            context=ctx))
+
+            dotted = _dotted(node.func)
+            if dotted.split(".")[-1] == "with_sharding_constraint" and \
+                    len(node.args) > 1:
+                if _is_pspec_call(mnode, p_locals, node.args[1]) and \
+                        not in_mesh_scope(node):
+                    findings.append(Finding(
+                        "CSA603", info.path, node.lineno,
+                        "with_sharding_constraint with a bare "
+                        "PartitionSpec outside any `with mesh:` scope",
+                        context=ctx))
+
+        # CSA604: per-function producer/consumer spec tracking. Named
+        # shardings resolve through single-target assigns (module level,
+        # then function-local) so `SPEC = NamedSharding(mesh, P('v'))`
+        # compares equal to the same spec written inline.
+        module_assigns: Dict[str, ast.AST] = {}
+        for stmt in info.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                module_assigns[stmt.targets[0].id] = stmt.value
+        for fn in ast.walk(info.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            spec_of: Dict[str, str] = {}
+            nodes = [n for n in jitmap.own_nodes(fn)
+                     if isinstance(n, ast.Assign)]
+            nodes.sort(key=lambda n: n.lineno)
+            local_assigns = dict(module_assigns)
+            for node in nodes:
+                if len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name):
+                    local_assigns[node.targets[0].id] = node.value
+            for node in nodes:
+                if not isinstance(node.value, ast.Call):
+                    continue
+                call = node.value
+                last = _dotted(call.func).split(".")[-1]
+                if last not in ("device_put", "with_sharding_constraint"):
+                    continue
+                if len(call.args) < 2:
+                    continue
+                src, spec_expr = call.args[0], call.args[1]
+                if isinstance(spec_expr, ast.Name):
+                    spec_expr = local_assigns.get(spec_expr.id, spec_expr)
+                pspec = _inner_pspec(mnode, p_locals, spec_expr)
+                key = _spec_key(pspec if pspec is not None else spec_expr)
+                if isinstance(src, ast.Name) and \
+                        spec_of.get(src.id, key) != key:
+                    findings.append(Finding(
+                        "CSA604", info.path, node.lineno,
+                        f"`{src.id}` produced with spec "
+                        f"{spec_of[src.id]} is re-specced to {key} "
+                        f"(implicit reshard)",
+                        context=info.qualname(fn)))
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        spec_of[tgt.id] = key
+    return findings

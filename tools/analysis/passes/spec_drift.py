@@ -1,0 +1,229 @@
+"""CSA8xx — differential spec drift vs the reference pyspec.
+
+The TPU port must track the reference pyspec's surface exactly: the
+constants in `configs/*.yaml` (loaded by `utils/config.py`) and the
+spec functions `models/phase0/spec.py` binds as methods. Nothing in the
+test suite diffs them — a renamed helper or a drifted constant simply
+becomes "our" behavior. This pass parses the reference tree under
+`--reference-root` (default `$CSTPU_REFERENCE_ROOT` or
+`/root/reference`) with zero imports of either side:
+
+  constants  reference `configs/constant_presets/<name>.yaml` vs the
+             port's `configs/<name>.yaml`, flat key: value comparison
+             (a tiny stdlib parser — the CI lint job has no pyyaml)
+  functions  `def` signatures from the reference pyspec `.py` files vs
+             the port's phase-0 spec surface (module-level defs whose
+             first parameter is `spec` — the bound-method convention),
+             compared by name and parameter order after dropping the
+             port's leading `spec`
+
+When the reference tree is absent the pass emits an explicit notice and
+reports nothing: CI machines do not carry the reference checkout.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from ..core import Finding, register_program_pass, register_rule
+from ..callgraph import Program
+
+register_rule(
+    "CSA801",
+    "constant value drift between a reference preset and the port's",
+    "error",
+    "the port's configs/*.yaml must carry the reference values verbatim; "
+    "fix the port (or record a deliberate divergence in the baseline)",
+)
+register_rule(
+    "CSA802",
+    "constant present in the reference preset but missing from the port",
+    "warning",
+    "add the constant to the port preset even if unused yet — spec "
+    "functions index presets by name at runtime",
+)
+register_rule(
+    "CSA803",
+    "reference spec function missing from the port's phase-0 surface",
+    "warning",
+    "port the function (taking `spec` first, per the bound-method "
+    "convention) or baseline the entry with the reason it is not needed",
+)
+register_rule(
+    "CSA804",
+    "parameter names/order drift from the reference spec function",
+    "error",
+    "keep the reference parameter order after the leading `spec`; "
+    "callers ported later pass positionally",
+)
+
+_UPPER_CONST = re.compile(r"^[A-Z][A-Z0-9_]*$")
+
+
+def parse_flat_yaml(path: Path) -> Dict[str, str]:
+    """`KEY: value` pairs of a flat preset file, values as normalized
+    strings (quotes stripped, ints canonicalized) — enough for the
+    constant presets, with no pyyaml dependency in the lint lane."""
+    out: Dict[str, str] = {}
+    for line in path.read_text().splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line or ":" not in line:
+            continue
+        key, _, value = line.partition(":")
+        key, value = key.strip(), value.strip().strip("'\"")
+        if not _UPPER_CONST.match(key):
+            continue
+        try:
+            value = str(int(value, 0))
+        except ValueError:
+            pass
+        out[key] = value
+    return out
+
+
+def _ref_presets(ref_root: Path) -> Dict[str, Path]:
+    """preset name -> reference yaml path."""
+    candidates = list(ref_root.glob("configs/constant_presets/*.yaml"))
+    if not candidates:
+        candidates = list(ref_root.glob("**/constant_presets/*.yaml"))
+    return {p.stem: p for p in candidates}
+
+
+def _ref_functions(ref_root: Path) -> Dict[str, Tuple[List[str], str]]:
+    """function name -> (param names, defining file) from the reference
+    pyspec python sources (the eth2spec/pyspec subtree when present,
+    else every .py under the root)."""
+    roots = [d for d in (ref_root / "test_libs" / "pyspec",
+                         ref_root / "pyspec") if d.is_dir()] or [ref_root]
+    out: Dict[str, Tuple[List[str], str]] = {}
+    for root in roots:
+        for path in sorted(root.rglob("*.py")):
+            try:
+                tree = ast.parse(path.read_text())
+            except (SyntaxError, UnicodeDecodeError):
+                continue
+            for node in tree.body:
+                if not isinstance(node, ast.FunctionDef) or \
+                        node.name.startswith("_"):
+                    continue
+                params = [a.arg for a in node.args.posonlyargs
+                          + node.args.args]
+                out.setdefault(node.name, (params, str(path)))
+    return out
+
+
+def _port_functions(program: Program, prefix: str
+                    ) -> Dict[str, Tuple[List[str], str, int]]:
+    """name -> (params-after-spec, path, lineno) for module-level defs
+    in `prefix` modules whose first parameter is `spec` (the surface
+    spec.py binds as methods)."""
+    out: Dict[str, Tuple[List[str], str, int]] = {}
+    for name, mnode in sorted(program.modules.items()):
+        if prefix not in name:
+            continue
+        for fname, fn in mnode.defs.items():
+            if fname.startswith("_"):
+                continue
+            params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+            if not params or params[0] != "spec":
+                continue
+            out.setdefault(fname, (params[1:], mnode.info.path, fn.lineno))
+    return out
+
+
+def _rel(path: Path) -> str:
+    """Anchor findings with a cwd-relative path when possible: the
+    fingerprint embeds the path, and an absolute one would never match
+    the same finding from another checkout location."""
+    try:
+        return str(path.resolve().relative_to(Path.cwd()))
+    except ValueError:
+        return str(path)
+
+
+def _line_of(path: Path, key: str) -> int:
+    try:
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            if line.split(":", 1)[0].strip() == key:
+                return i
+    except OSError:
+        pass
+    return 1
+
+
+@register_program_pass
+def run(program: Program) -> List[Finding]:
+    opts = program.options
+    ref_root = Path(opts.get("reference_root")
+                    or os.environ.get("CSTPU_REFERENCE_ROOT")
+                    or "/root/reference")
+    if not ref_root.is_dir():
+        program.notices.append(
+            f"CSA8xx spec-drift: reference tree absent at {ref_root}; "
+            f"pass skipped (set --reference-root to enable)")
+        program.skipped_rules.update(
+            ("CSA801", "CSA802", "CSA803", "CSA804"))
+        return []
+
+    findings: List[Finding] = []
+    repo_root = Path(__file__).resolve().parents[3]
+    port_configs = Path(opts.get("drift_port_configs")
+                        or repo_root / "configs")
+
+    # -- constants ----------------------------------------------------------
+    for preset, ref_path in sorted(_ref_presets(ref_root).items()):
+        port_path = port_configs / f"{preset}.yaml"
+        if not port_path.exists():
+            program.notices.append(
+                f"CSA8xx spec-drift: no port preset for reference "
+                f"'{preset}' ({port_path} missing)")
+            continue
+        ref_consts = parse_flat_yaml(ref_path)
+        port_consts = parse_flat_yaml(port_path)
+        for key, ref_value in sorted(ref_consts.items()):
+            if key not in port_consts:
+                findings.append(Finding(
+                    "CSA802", _rel(port_path), 1,
+                    f"constant {key} ({preset}) in the reference preset "
+                    f"but not the port's",
+                    context=f"preset:{preset}"))
+            elif port_consts[key] != ref_value:
+                findings.append(Finding(
+                    "CSA801", _rel(port_path), _line_of(port_path, key),
+                    f"constant {key} ({preset}) drifted: port has "
+                    f"{port_consts[key]}, reference has {ref_value}",
+                    context=f"preset:{preset}"))
+
+    # -- function signatures ------------------------------------------------
+    prefix = str(opts.get("drift_port_prefix") or "models.phase0")
+    port_fns = _port_functions(program, prefix)
+    if not port_fns:
+        program.notices.append(
+            f"CSA8xx spec-drift: no port modules matching '{prefix}'; "
+            f"function diff skipped")
+        return findings
+    spec_mod = program.module_named(f"{prefix}.spec".lstrip("."))
+    fn_anchor = spec_mod.info.path if spec_mod else \
+        next(iter(port_fns.values()))[1]
+    for fname, (ref_params, ref_file) in sorted(_ref_functions(
+            ref_root).items()):
+        port = port_fns.get(fname)
+        if port is None:
+            findings.append(Finding(
+                "CSA803", fn_anchor, 1,
+                f"reference spec function `{fname}` "
+                f"({Path(ref_file).name}) has no port counterpart",
+                context="spec-surface"))
+            continue
+        port_params, port_path, lineno = port
+        if port_params != ref_params:
+            findings.append(Finding(
+                "CSA804", port_path, lineno,
+                f"`{fname}` parameters drifted: port "
+                f"({', '.join(port_params)}) vs reference "
+                f"({', '.join(ref_params)})",
+                context=fname))
+    return findings
