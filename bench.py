@@ -71,7 +71,12 @@ def bench_epoch_device() -> float:
         slashed_p=0.001, incl_delay_max=32, random_slashed_balances=True)
     seed = bytes(range(32))
 
-    _sync(epoch_transition_device(cfg, cols, scal, inp))
+    # epoch_transition_device DONATES the columns; chain each iteration's
+    # output columns into the next call (the production shape: epoch N's
+    # registry feeds epoch N+1) instead of reusing a deleted buffer
+    out = epoch_transition_device(cfg, cols, scal, inp)
+    _sync(out)
+    cols = out[0]
     _sync(shuffle_permutation_on_device(seed, V_DEVICE, spec.SHUFFLE_ROUND_COUNT))
 
     iters = EPOCH_ITERS
@@ -79,6 +84,7 @@ def bench_epoch_device() -> float:
     for _ in range(iters):
         perm = shuffle_permutation_on_device(seed, V_DEVICE, spec.SHUFFLE_ROUND_COUNT)
         out = epoch_transition_device(cfg, cols, scal, inp)
+        cols = out[0]
         _sync(perm)
         _sync(out)
     return (time.perf_counter() - t0) / iters
@@ -116,6 +122,93 @@ def bench_state_root_device() -> float:
         # (np.asarray + tobytes), which IS the completion fence here
         bulk.registry_and_balances_roots_device(*dev)
     return (time.perf_counter() - t0) / iters
+
+
+def bench_incremental_root_device():
+    """Incremental state-root: ≤1k dirty leaves of a V_DEVICE-leaf resident
+    Merkle forest (utils/ssz/incremental.py) vs the full forest rebuild —
+    the cost a registry-mutating block pays between epoch boundaries now
+    (O(dirty·log V)) vs what the old all-or-nothing cache forced (O(V)).
+    Leaves stay device-resident throughout; the only download per root is
+    its 32 bytes. Returns a dict for the JSON artifact."""
+    import jax.numpy as jnp
+    from consensus_specs_tpu.utils.ssz.incremental import IncrementalMerkleTree
+
+    rng = np.random.default_rng(3)
+    V = V_DEVICE
+    n_dirty = min(1024, max(1, V // 64))
+    leaves_dev = jnp.asarray(rng.integers(0, 2 ** 32, (V, 8), dtype=np.uint32))
+    _sync(leaves_dev)
+
+    def rebuild():
+        # the tree takes ownership (level scatters donate): hand it a fresh
+        # DEVICE copy so the source leaves stay reusable and no host
+        # transfer pollutes the measurement
+        t = IncrementalMerkleTree(jnp.array(leaves_dev, copy=True))
+        t.root()                      # 32-byte download = the fence
+        return t
+
+    tree = rebuild()                  # warm the per-level compile cache
+    iters = 2
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        tree = rebuild()
+    t_rebuild = (time.perf_counter() - t0) / iters
+    pairs_rebuild = sum(tree.last_pairs_per_level)
+
+    dirty = np.sort(rng.choice(V, n_dirty, replace=False)).astype(np.int32)
+    rows = rng.integers(0, 2 ** 32, (n_dirty, 8), dtype=np.uint32)
+    tree.update(dirty, rows)          # warm the update-shape compiles
+    tree.root()
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        tree.update(dirty, rows)
+        tree.root()
+    t_update = (time.perf_counter() - t0) / iters
+    # the acceptance bound, asserted at the real shape: an update re-hashes
+    # O(dirty·log V) pair lanes (pow2 index padding at worst doubles them)
+    assert sum(tree.last_pairs_per_level) <= 2 * n_dirty * tree.depth, \
+        tree.last_pairs_per_level
+    return {
+        "leaves": V,
+        "dirty": int(n_dirty),
+        "incremental_ms": round(t_update * 1e3, 2),
+        "full_rebuild_ms": round(t_rebuild * 1e3, 2),
+        "speedup": round(t_rebuild / t_update, 1),
+        "pair_lanes_incremental": int(sum(tree.last_pairs_per_level)),
+        "pair_lanes_full": int(pairs_rebuild),
+    }
+
+
+def bench_merkle_backend_ab():
+    """A/B the two pair-hash kernels (CSTPU_MERKLE_BACKEND=xla|pallas) on
+    one Merkle-level-shaped batch — the selection ops/sha256_pallas.py's
+    docstring always promised. On non-TPU backends the Pallas form runs the
+    eager interpreter (Mosaic is TPU-only), so the CPU smoke numbers are
+    about correctness plumbing, not kernel speed."""
+    import jax
+    import jax.numpy as jnp
+    from consensus_specs_tpu.ops import sha256 as S
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    lanes = 1 << 20 if on_tpu else 1 << 11
+    rng = np.random.default_rng(9)
+    words = jnp.asarray(rng.integers(0, 2 ** 32, (lanes, 16), dtype=np.uint32))
+    _sync(words)
+    out = {"lanes": lanes}
+    for name in ("xla", "pallas"):
+        S.set_merkle_pair_backend(name)
+        try:
+            _sync(S.pair_hash_words(words))     # warm compile
+            iters = 3 if (on_tpu or name == "xla") else 1
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                _sync(S.pair_hash_words(words))
+            out[f"{name}_ms"] = round((time.perf_counter() - t0) / iters * 1e3, 2)
+        finally:
+            S.set_merkle_pair_backend(None)
+    return out
 
 
 def _stage_attestation_pairs(n_groups, n_distinct=8):
@@ -748,7 +841,17 @@ def main():
         _progress(f"epoch {t_epoch * 1e3:.1f} ms; state root ({V_DEVICE} validators)")
     t_root = _device("state-root kernel", bench_state_root_device)
     if t_root is not None:
-        _progress(f"state root {t_root * 1e3:.1f} ms; BLS batch ({N_ATTESTATIONS} groups)")
+        _progress(f"state root {t_root * 1e3:.1f} ms; incremental root "
+                  f"({V_DEVICE} leaves)")
+    inc = _device("incremental root", bench_incremental_root_device)
+    if inc is not None:
+        _progress("incremental root %(incremental_ms).1f ms (%(dirty)d dirty) "
+                  "vs full rebuild %(full_rebuild_ms).0f ms = %(speedup).1fx; "
+                  "pair-hash backend A/B" % inc)
+    ab = _device("merkle backend A/B", bench_merkle_backend_ab)
+    if ab is not None:
+        _progress("pair-hash A/B: xla %(xla_ms).1f ms, pallas %(pallas_ms).1f "
+                  "ms @ %(lanes)d lanes" % ab)
     bls_res = _device("BLS batch", bench_bls_device)
     t_bls, t_py_verify = bls_res if bls_res is not None else (None, None)
     if t_bls is not None:
@@ -775,6 +878,15 @@ def main():
         parts.append("kernel epoch %.1f ms" % (t_epoch * 1e3))
     if t_root is not None:
         parts.append("kernel root %.1f ms" % (t_root * 1e3))
+    if inc is not None:
+        parts.append(
+            "incremental state-root %.1f ms (%d dirty of %d leaves; full "
+            "forest rebuild %.0f ms, %.1fx)" % (
+                inc["incremental_ms"], inc["dirty"], inc["leaves"],
+                inc["full_rebuild_ms"], inc["speedup"]))
+    if ab is not None:
+        parts.append("pair-hash A/B xla %.1f / pallas %.1f ms @ %d lanes" % (
+            ab["xla_ms"], ab["pallas_ms"], ab["lanes"]))
     if t_bls is not None:
         parts.append("%d-agg-verify %.1f ms = %.0f aggverify/s/chip" % (
             N_ATTESTATIONS, t_bls * 1e3, N_ATTESTATIONS / t_bls))
@@ -799,12 +911,17 @@ def main():
                      "numbers are not TPU-comparable")
     parts.append("python baseline %.0f ms scaled over the measured stages"
                  % py_total_ms)
-    print(json.dumps({
+    record = {
         "metric": metric,
         "value": round(total_ms, 1),
         "unit": "ms (%s)" % "; ".join(parts),
         "vs_baseline": round(py_total_ms / total_ms, 1),
-    }))
+    }
+    if inc is not None:
+        record["incremental_root"] = inc
+    if ab is not None:
+        record["merkle_backend_ab"] = ab
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -352,16 +352,47 @@ def _stage_b_traced(cfg: EpochConfig, cols: ValidatorColumns,
     return new_cols, new_scal
 
 
-@partial(jax.jit, static_argnums=(0,))
+def _epoch_transition_traced(cfg: EpochConfig, cols: ValidatorColumns,
+                             scal: EpochScalars, inp: EpochInputs):
+    mid_cols, mid_scal, report = _stage_a_traced(cfg, cols, scal, inp)
+    new_cols, new_scal = _stage_b_traced(cfg, mid_cols, mid_scal)
+    return new_cols, new_scal, report
+
+
+# The donated form: every output column matches an input column's
+# shape/dtype, so XLA updates the registry in place instead of holding
+# input+output copies in HBM (the 1M-validator column set is ~7x8 MB —
+# donation halves its footprint during the epoch program). The donation
+# actually sticking (no "donated buffer unused" warnings, input buffers
+# consumed) is asserted in tests/test_epoch_soa.py against this jit.
+_epoch_transition_donated = partial(
+    jax.jit, static_argnums=(0,), donate_argnums=(1,))(_epoch_transition_traced)
+_epoch_transition_undonated = partial(
+    jax.jit, static_argnums=(0,))(_epoch_transition_traced)
+
+
 def epoch_transition_device(cfg: EpochConfig, cols: ValidatorColumns,
                             scal: EpochScalars, inp: EpochInputs):
     """The whole numeric epoch transition, one traced program (the phase-0
     fast path: both stages fuse — XLA sees exactly the pre-split op graph).
     Phase 1 runs the two stages as separate programs with the insert hooks
-    between (process_epoch_soa)."""
-    mid_cols, mid_scal, report = _stage_a_traced(cfg, cols, scal, inp)
-    new_cols, new_scal = _stage_b_traced(cfg, mid_cols, mid_scal)
-    return new_cols, new_scal, report
+    between (process_epoch_soa).
+
+    The validator columns are DONATED on accelerator backends; callers must
+    not reuse a jnp `cols` after the call (numpy inputs upload to a
+    temporary and stay valid) — ResidentCore rebinds `self.cols` to the
+    returned columns, and bench/tests chain outputs. XLA:CPU is pinned to
+    the undonated form: a donated CPU executable loaded back from the
+    persistent compilation cache intermittently ignores its input/output
+    aliasing and clobbers a donated input with an intermediate (observed on
+    jax 0.4.37 as the balance column coming back as the activation-queue
+    iota after the second chained boundary; freshly compiled donated
+    executables never reproduced it in stress runs). The tests differential
+    against the object model on CPU, so correctness there must not depend
+    on cache temperature."""
+    fn = (_epoch_transition_undonated if jax.default_backend() == "cpu"
+          else _epoch_transition_donated)
+    return fn(cfg, cols, scal, inp)
 
 
 _stage_a_jit = partial(jax.jit, static_argnums=(0,))(_stage_a_traced)

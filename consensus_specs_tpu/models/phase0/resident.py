@@ -16,10 +16,13 @@ small byte-rooted fields. This module makes that story real:
     `get_total_balance` — so the UNMODIFIED process_block /
     process_attestation code runs against stale object numerics without
     ever touching them.
-  * per-slot state roots combine the cached device registry/balances roots
-    (bulk.registry_and_balances_roots_device over the resident columns)
-    with the bulk-memoized roots of every other field — the object
-    registry is never materialized for a root.
+  * per-slot state roots combine the registry/balances roots of two
+    device-resident INCREMENTAL Merkle forests (utils/ssz/incremental.py:
+    every tree level stays on device, invalidation is per leaf) with the
+    bulk-memoized roots of every other field — the object registry is
+    never materialized for a root, and a registry-mutating block re-hashes
+    only the validators it touched (O(dirty * log V)) instead of forcing
+    the old all-or-nothing registry-scale rebuild.
   * at an epoch boundary the existing distillation machinery
     (build_epoch_context / process_crosslinks_vectorized /
     build_epoch_inputs) runs straight off the mirrors — the object-walk
@@ -31,9 +34,13 @@ small byte-rooted fields. This module makes that story real:
     come back.
   * blocks carrying registry-mutating operations (slashings, deposits,
     exits, transfers) take the fallback: exit residency (one writeback),
-    process the block through the untouched object path, re-enter (one
-    upload). Correctness is the object path's by construction; the cost
-    is the documented price of rare operations.
+    process the block through the untouched object path, re-enter
+    INCREMENTALLY — the re-entry diffs the columns against the pre-block
+    snapshot, scatters only the changed rows back to device, and updates
+    the forests at leaf granularity (deposit growth append-grows them,
+    crossing padded powers of two included). Correctness is the object
+    path's by construction; the re-Merkleization cost is now proportional
+    to the block, not the registry.
 
 Reference semantics covered: per-slot root caching (0_beacon-chain.md
 :1173-1191), process_epoch ordering (:1251-1262), final updates
@@ -50,6 +57,8 @@ import numpy as np
 import jax
 
 from ...utils.ssz import bulk
+from ...utils.ssz import impl as ssz_impl
+from ...utils.ssz.incremental import IncrementalMerkleTree
 from . import helpers as helpers_mod
 from .epoch_soa import (EpochConfig, ValidatorColumns, build_epoch_context,
                         build_epoch_inputs, columns_np_from_state,
@@ -81,6 +90,18 @@ def light_state_from_bytes(spec, data: bytes):
         lo, hi = spans[name]
         setattr(state, name, deserialize(bytes(data[lo:hi]), typ))
     return state
+
+
+def _balance_chunk_words_np(bal: np.ndarray, chunk_idx: np.ndarray) -> np.ndarray:
+    """[k, 8] words of the balances list's SSZ pack chunks at `chunk_idx`
+    (4 uint64 per 32-byte chunk, zero-padded past the list end)."""
+    from ...ops.sha256 import bytes_to_words
+    n = bal.shape[0]
+    k = chunk_idx.shape[0]
+    pos = np.asarray(chunk_idx, np.int64)[:, None] * 4 + np.arange(4)[None, :]
+    vals = np.where(pos < n, bal[np.minimum(pos, max(n - 1, 0))], np.uint64(0))
+    chunks = vals.astype("<u8").view(np.uint8).reshape(k, 32)
+    return bytes_to_words(chunks)
 
 
 def _common_path_block(block) -> bool:
@@ -175,6 +196,11 @@ class ResidentCore:
         self.pk_dev = jnp.asarray(self._pk_np)
         self.wc_dev = jnp.asarray(self._wc_np)
         self._big_roots: Optional[tuple] = None
+        # Per-column incremental Merkle forests (utils/ssz/incremental.py),
+        # built lazily on the first root request; a fresh entry cannot reuse
+        # old trees (unknown provenance of the new columns)
+        self._reg_forest: Optional[IncrementalMerkleTree] = None
+        self._bal_forest: Optional[IncrementalMerkleTree] = None
         self._active_idx_memo.clear()
         self._install()
 
@@ -195,13 +221,18 @@ class ResidentCore:
                 "registry to materialize into; serialize via "
                 "checkpoint_bytes() instead")
         try:
-            new_cols = jax.device_get(self.cols)
-            _apply_validator_columns(self.state, new_cols)
+            _apply_validator_columns(
+                self.state, ValidatorColumns(**self._materialize_np_cols()))
             # _apply_validator_columns skips `slashed` (the epoch program
             # never writes it); the object copy is already authoritative.
         finally:
             self._uninstall()
         return self.state
+
+    def _materialize_np_cols(self) -> Dict[str, np.ndarray]:
+        """One download of the device columns as a host dict."""
+        cols = jax.device_get(self.cols)
+        return {f: np.asarray(getattr(cols, f)) for f in _ALL_FIELDS}
 
     def checkpoint_bytes(self) -> bytes:
         """Serialize the resident state WITHOUT materializing the registry:
@@ -232,10 +263,127 @@ class ResidentCore:
         return _cm()
 
     def _fallback_block(self, state, block) -> None:
-        """Exit -> unmodified object-path block -> re-enter."""
-        self.exit()
+        """Exit -> unmodified object-path block -> INCREMENTAL re-enter.
+
+        Correctness stays the object path's by construction; the cost no
+        longer includes a full re-Merkleization. Re-entry diffs the columns
+        the block changed against the pre-block snapshot, scatters only
+        those rows into the device columns, and re-hashes only the touched
+        validators' root paths in the incremental forests — a slashing or
+        exit that moves a handful of validators costs O(dirty * log V)
+        compressions, not the ~2M-leaf rebuild the old all-or-nothing
+        `_big_roots` cache forced."""
+        old_np = self._materialize_np_cols()
+        try:
+            _apply_validator_columns(self.state, ValidatorColumns(**old_np))
+        finally:
+            self._uninstall()
         self.spec.process_block(state, block)
-        self._enter(state)
+        self._reenter_incremental(state, old_np)
+
+    def _reenter_incremental(self, state, old_np: Dict[str, np.ndarray]) -> None:
+        """Resume residency after an object-path block by diffing columns
+        against the pre-block snapshot: changed rows scatter into the device
+        columns, appended validators (deposits) extend them, and the forests
+        invalidate at leaf granularity (append-grow included)."""
+        import jax.numpy as jnp
+        self.state = state
+        np_cols = dict(columns_np_from_state(state))
+        old_n = old_np["balance"].shape[0]
+        new_n = np_cols["balance"].shape[0]
+        grown = new_n - old_n
+        assert grown >= 0, "the registry never shrinks (spec invariant)"
+        if grown:
+            pk_new = np.zeros((grown, 48), np.uint8)
+            wc_new = np.zeros((grown, 32), np.uint8)
+            for i, v in enumerate(state.validator_registry[old_n:]):
+                pk_new[i] = np.frombuffer(bytes(v.pubkey), np.uint8)
+                wc_new[i] = np.frombuffer(bytes(v.withdrawal_credentials),
+                                          np.uint8)
+            self._pk_np = np.concatenate([self._pk_np, pk_new])
+            self._wc_np = np.concatenate([self._wc_np, wc_new])
+            # upload only the appended rows and concatenate ON DEVICE — a
+            # one-validator deposit must not re-upload the ~80 MB identity
+            # matrices of a 1M-validator registry
+            self.pk_dev = jnp.concatenate([self.pk_dev, jnp.asarray(pk_new)])
+            self.wc_dev = jnp.concatenate([self.wc_dev, jnp.asarray(wc_new)])
+        dirty: Dict[str, np.ndarray] = {}
+        new_cols = {}
+        for f in _ALL_FIELDS:
+            new = np_cols[f]
+            idx = np.nonzero(new[:old_n] != old_np[f])[0]
+            dirty[f] = idx
+            dev = getattr(self.cols, f)
+            if idx.size:
+                dev = dev.at[jnp.asarray(idx.astype(np.int32))].set(
+                    jnp.asarray(new[idx]))
+            if grown:
+                dev = jnp.concatenate([dev, jnp.asarray(new[old_n:])])
+            new_cols[f] = dev
+        self.cols = ValidatorColumns(**new_cols)
+        self.mirrors = {f: np_cols[f].copy() for f in _MIRROR_FIELDS}
+        self._active_idx_memo.clear()
+        self._update_forests(np_cols, old_n, dirty)
+        self._big_roots = None
+        self._install()
+
+    # registry-leaf fields: everything the Validator container Merkleizes
+    # except the separate balances list (pubkey/wc never change in place)
+    _LEAF_FIELDS = ("activation_eligibility_epoch", "activation_epoch",
+                    "exit_epoch", "withdrawable_epoch", "slashed",
+                    "effective_balance")
+
+    def _update_forests(self, np_cols: Dict[str, np.ndarray], old_n: int,
+                        dirty: Dict[str, np.ndarray]) -> None:
+        """Leaf-granularity forest invalidation after an object-path block:
+        recompute only the touched validators' leaves (host-side, O(dirty))
+        and re-hash their root paths; append leaves/chunks for registry
+        growth — the append-grow path crosses padded powers of two exactly
+        like utils/ssz/incremental.py's tests."""
+        new_n = np_cols["balance"].shape[0]
+        if self._reg_forest is not None:
+            reg_dirty = np.unique(np.concatenate(
+                [dirty[f] for f in self._LEAF_FIELDS]))
+            if reg_dirty.size:
+                self._reg_forest.update(
+                    reg_dirty.astype(np.int32),
+                    self._registry_leaf_words_np(np_cols, reg_dirty))
+            if new_n > old_n:
+                grown_idx = np.arange(old_n, new_n)
+                self._reg_forest.append(
+                    self._registry_leaf_words_np(np_cols, grown_idx))
+        if self._bal_forest is not None:
+            bal = np_cols["balance"]
+            old_c = max(1, -(-old_n // 4))
+            new_c = max(1, -(-new_n // 4))
+            chunk_dirty = dirty["balance"] // 4
+            if new_n > old_n and old_n % 4:
+                # growth refills the old partial tail chunk in place
+                chunk_dirty = np.concatenate([chunk_dirty, [old_n // 4]])
+            chunk_dirty = np.unique(chunk_dirty)
+            if chunk_dirty.size:
+                self._bal_forest.update(
+                    chunk_dirty.astype(np.int32),
+                    _balance_chunk_words_np(bal, chunk_dirty))
+            if new_c > old_c:
+                self._bal_forest.append(_balance_chunk_words_np(
+                    bal, np.arange(old_c, new_c)))
+
+    def _registry_leaf_words_np(self, np_cols: Dict[str, np.ndarray],
+                                idx: np.ndarray):
+        """[k, 8] word leaves (validator hash_tree_roots) for a small index
+        set, computed host-side from the post-block columns."""
+        from ...ops.sha256 import bytes_to_words
+        leaves = bulk.validator_leaf_chunks(
+            self._pk_np[idx], self._wc_np[idx],
+            np_cols["activation_eligibility_epoch"][idx],
+            np_cols["activation_epoch"][idx],
+            np_cols["exit_epoch"][idx],
+            np_cols["withdrawable_epoch"][idx],
+            np_cols["slashed"][idx],
+            np_cols["effective_balance"][idx])
+        roots = bulk.subtree_roots_batch(leaves)
+        return bytes_to_words(np.ascontiguousarray(roots))
 
     # -- spec-method overrides ----------------------------------------------
 
@@ -308,12 +456,38 @@ class ResidentCore:
     # -- state roots --------------------------------------------------------
 
     def _registry_balances_roots(self):
-        if self._big_roots is None:
-            c = self.cols
+        """(registry_root, balances_root) from the incremental forests.
+
+        First request after an (epoch-boundary or entry) invalidation builds
+        the forests from the device columns — one traced leaf program plus a
+        batched pair-hash launch per level, the same O(V) the old one-shot
+        device root paid. Every request between boundaries is O(1) (cached)
+        or O(dirty * log V) after a fallback block's leaf-level updates —
+        never the all-or-nothing ~2M-leaf re-Merkleization."""
+        if self._big_roots is not None:
+            return self._big_roots
+        c = self.cols
+        V = int(c.balance.shape[0])
+        if V == 0 or self.pk_dev.shape[0] == 0:
+            # degenerate metadata-only state: the numpy oracle short-circuit
             self._big_roots = bulk.registry_and_balances_roots_device(
                 self.pk_dev, self.wc_dev, c.activation_eligibility_epoch,
                 c.activation_epoch, c.exit_epoch, c.withdrawable_epoch,
                 c.slashed, c.effective_balance, c.balance)
+            return self._big_roots
+        if self._reg_forest is None:
+            self._reg_forest = IncrementalMerkleTree(
+                bulk.registry_leaf_words_device(
+                    self.pk_dev, self.wc_dev, c.activation_eligibility_epoch,
+                    c.activation_epoch, c.exit_epoch, c.withdrawable_epoch,
+                    c.slashed, c.effective_balance))
+        if self._bal_forest is None:
+            self._bal_forest = IncrementalMerkleTree(
+                bulk.balances_chunk_words_device(c.balance))
+        self._big_roots = (
+            ssz_impl.mix_in_length(self._reg_forest.root(),
+                                   self.pk_dev.shape[0]),
+            ssz_impl.mix_in_length(self._bal_forest.root(), V))
         return self._big_roots
 
     def _state_root(self, state):
@@ -431,6 +605,10 @@ class ResidentCore:
 
         self.cols = dev_cols
         self._big_roots = None
+        # the boundary dirties every leaf (rewards touch all balances):
+        # degenerate to a full forest rebuild — exactly today's cost floor
+        self._reg_forest = None
+        self._bal_forest = None
         self._active_idx_memo.clear()
         new_scal, report = jax.device_get((dev_scal, dev_report))
         _apply_justification(spec, state, new_scal, report,

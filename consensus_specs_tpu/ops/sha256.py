@@ -256,10 +256,13 @@ def _sha256_multiblock(words: jnp.ndarray) -> jnp.ndarray:
 # Device-side Merkle reduction
 # ---------------------------------------------------------------------------
 
-def _zerohash_words(depth: int) -> np.ndarray:
+def zerohash_words(depth: int) -> np.ndarray:
     """[8] uint32 big-endian words of the depth-`depth` zero-subtree root."""
     from ..utils.hash import zerohashes  # local import to avoid cycle
     return bytes_to_words(np.frombuffer(zerohashes[depth], dtype=np.uint8))
+
+
+_zerohash_words = zerohash_words  # internal alias (pre-export name)
 
 
 def merkle_reduce_words(chunks: jnp.ndarray) -> jnp.ndarray:
@@ -332,6 +335,54 @@ def merkle_root_from_leaves_device(leaves_bytes: Sequence[bytes], pad_to: int) -
     words = jnp.asarray(bytes_to_words(arr))
     root = merkle_root_device(words, depth)
     return words_to_bytes(np.asarray(root)).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Selectable Merkle pair-hash backend: the XLA kernel vs the Pallas kernel.
+#
+# sha256_pairs_pallas (ops/sha256_pallas.py) has always promised an on-chip
+# A/B against the XLA form; this switch is what actually selects it. The
+# host-orchestrated Merkle paths — bulk.hash_pairs_array and the incremental
+# forest (utils/ssz/incremental.py) — route every level through
+# pair_hash_words, so CSTPU_MERKLE_BACKEND=pallas swaps the kernel under
+# them without touching call sites. The one-program traced reductions
+# (merkle_reduce_words et al.) keep the inlined XLA form: they are compiled
+# as a single fused program where the kernel choice is part of the trace.
+# ---------------------------------------------------------------------------
+
+_PAIR_BACKENDS = ("xla", "pallas")
+_pair_backend_override: Optional[str] = None
+
+
+def set_merkle_pair_backend(name: Optional[str]) -> None:
+    """Pin the pair-hash backend ("xla"/"pallas"); None returns control to
+    the CSTPU_MERKLE_BACKEND environment variable (default "xla")."""
+    global _pair_backend_override
+    assert name is None or name in _PAIR_BACKENDS, name
+    _pair_backend_override = name
+
+
+def merkle_pair_backend_name() -> str:
+    import os
+    name = _pair_backend_override or os.environ.get(
+        "CSTPU_MERKLE_BACKEND", "xla")
+    if name not in _PAIR_BACKENDS:
+        raise ValueError(
+            f"CSTPU_MERKLE_BACKEND must be one of {_PAIR_BACKENDS}, "
+            f"got {name!r}")
+    return name
+
+
+def pair_hash_words(words: jnp.ndarray) -> jnp.ndarray:
+    """[N, 16] uint32 words -> [N, 8] digests via the selected backend.
+
+    Host-orchestration entry point (called OUTSIDE jit, once per Merkle
+    level); both backends are bit-identical (tests/test_sha256_pallas.py,
+    tests/test_incremental_merkle.py)."""
+    if merkle_pair_backend_name() == "pallas":
+        from .sha256_pallas import sha256_pairs_pallas
+        return sha256_pairs_pallas(words)
+    return sha256_pairs(words)
 
 
 # ---------------------------------------------------------------------------

@@ -22,13 +22,24 @@ def next_power_of_two(v: int) -> int:
     return 1 << (v - 1).bit_length()
 
 
+def tree_depth(count: int) -> int:
+    """Levels of the power-of-two-padded tree over `count` chunks (SSZ
+    merkleize padding, specs/simple-serialize.md:139-147): 0 and 1 chunks
+    need no hashing, everything else pads up to next_power_of_two.
+
+    Shared by merkleize_chunks and the incremental forest
+    (utils/ssz/incremental.py), whose append-grow must agree with the
+    padded depth here — a leaf count crossing a power of two deepens the
+    tree by exactly the levels this function adds."""
+    return (next_power_of_two(count) - 1).bit_length()
+
+
 def merkleize_chunks(chunks: Sequence[bytes]) -> bytes:
     """Root of the power-of-two-padded binary tree over 32-byte chunks."""
     count = len(chunks)
     if count == 0:
         return ZERO_BYTES32
-    size = next_power_of_two(count)
-    depth_needed = (size - 1).bit_length()
+    depth_needed = tree_depth(count)
     level = list(chunks)
     depth = 0
     while len(level) > 1 or depth < depth_needed:

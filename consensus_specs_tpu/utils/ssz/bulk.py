@@ -57,13 +57,15 @@ def hash_pairs_array(pairs: np.ndarray) -> np.ndarray:
     n = pairs.shape[0]
     if n >= _DEVICE_MIN_PAIRS:
         import jax.numpy as jnp
-        from ...ops.sha256 import bytes_to_words, sha256_pairs, words_to_bytes
+        from ...ops.sha256 import (bytes_to_words, pair_hash_words,
+                                   words_to_bytes)
         m = 1
         while m < n:
             m *= 2
         padded = np.zeros((m, 64), dtype=np.uint8)
         padded[:n] = pairs
-        digests = sha256_pairs(jnp.asarray(bytes_to_words(padded)))
+        # pair_hash_words is the CSTPU_MERKLE_BACKEND switch (XLA vs Pallas)
+        digests = pair_hash_words(jnp.asarray(bytes_to_words(padded)))
         return words_to_bytes(np.asarray(digests))[:n]
     import hashlib
     sha = hashlib.sha256
@@ -101,6 +103,17 @@ def _memo_put(kind, key: bytes, value) -> None:
         _memo_bytes = 0
     _memo[(kind, key)] = value
     _memo_bytes += len(key) + len(value) + 64
+
+
+def _memo_evict(kind, key: bytes) -> None:
+    """Drop one memo entry (mirror of _memo_put's accounting). Used by the
+    incremental tree handles: when a forest invalidates a leaf range, the
+    entries it inserted for the superseded content come out immediately
+    instead of lingering until the wholesale cap clear."""
+    global _memo_bytes
+    value = _memo.pop((kind, key), None)
+    if value is not None:
+        _memo_bytes = max(0, _memo_bytes - (len(key) + len(value) + 64))
 
 
 def _zero_chunk_rows(n: int, depth: int) -> np.ndarray:
@@ -168,6 +181,90 @@ def subtree_roots_batch(leaves: np.ndarray) -> np.ndarray:
     if key is not None:
         _memo_put(("srb", P), key, np.ascontiguousarray(roots).tobytes())
     return roots
+
+
+# ---------------------------------------------------------------------------
+# Tree-handle API: build -> update(leaf_idx, rows) -> root
+#
+# merkleize_chunk_array answers one-shot roots; callers that OWN a chunk
+# matrix and mutate it a few rows at a time (per-slot state roots between
+# epoch boundaries) get a persistent handle instead: the incremental forest
+# (utils/ssz/incremental.py) keeps every tree level resident and re-hashes
+# only the dirty root paths — O(dirty * log N) instead of O(N) per root.
+# ---------------------------------------------------------------------------
+
+class ChunkTreeHandle:
+    """Incremental root over an [N, 32] uint8 chunk matrix.
+
+    Keeps a host mirror of the chunks (updates are host-initiated) so the
+    content-keyed byte memo stays coherent: `root()` inserts its result
+    under the current content key exactly like merkleize_chunk_array, and
+    any invalidation (update/append) EVICTS the entries this handle put
+    there — forest invalidation and memo eviction move together, so a stale
+    root can never be served for superseded content, and dead keys do not
+    sit in the cap's accounting until the wholesale clear.
+    """
+
+    def __init__(self, chunks: np.ndarray):
+        from .incremental import tree_from_chunks
+        self._chunks = np.array(chunks, dtype=np.uint8)   # owned host mirror
+        assert self._chunks.ndim == 2 and self._chunks.shape[1] == 32
+        self.tree = tree_from_chunks(self._chunks)
+        self._memo_keys: list = []
+        self._memo_stale = True   # content not yet offered to the memo
+
+    @property
+    def n(self) -> int:
+        return self._chunks.shape[0]
+
+    def root(self) -> bytes:
+        root = self.tree.root()
+        n = self.n
+        # offer the root to the shared memo ONCE per content generation —
+        # the O(N) tobytes key build must not recur on every steady-state
+        # root (that would reintroduce the linear host cost the tree avoids)
+        if (self._memo_stale and _MEMO_MIN_CHUNKS <= n
+                and n * 32 <= _MEMO_MAX_KEY):
+            key = self._chunks.tobytes()
+            if ("mca", key) not in _memo:
+                _memo_put("mca", key, root)
+                self._memo_keys.append(("mca", key))
+            self._memo_stale = False
+        return root
+
+    def update(self, leaf_idx, rows: np.ndarray) -> None:
+        """Overwrite chunk rows; O(len(leaf_idx) * log N) re-hash."""
+        from ...ops.sha256 import bytes_to_words
+        rows = np.asarray(rows, np.uint8).reshape(-1, 32)
+        self.invalidate_memo()
+        # the tree validates (unique, in-range) BEFORE mutating anything:
+        # a rejected update must leave mirror and tree consistent, or the
+        # next root() would memoize the old root under the new content key
+        self.tree.update(leaf_idx, bytes_to_words(rows) if rows.shape[0]
+                         else np.zeros((0, 8), np.uint32))
+        self._chunks[np.asarray(leaf_idx, np.int64)] = rows
+
+    def append(self, rows: np.ndarray) -> None:
+        """Grow the chunk matrix (crossing padded powers of two included)."""
+        from ...ops.sha256 import bytes_to_words
+        rows = np.asarray(rows, np.uint8).reshape(-1, 32)
+        self.invalidate_memo()
+        self.tree.append(bytes_to_words(rows) if rows.shape[0]
+                         else np.zeros((0, 8), np.uint32))
+        self._chunks = np.concatenate([self._chunks, rows])
+
+    def invalidate_memo(self) -> None:
+        """Evict every memo entry this handle inserted (its content is about
+        to be superseded)."""
+        for kind, key in self._memo_keys:
+            _memo_evict(kind, key)
+        self._memo_keys.clear()
+        self._memo_stale = True
+
+
+def build_chunk_tree(chunks: np.ndarray) -> ChunkTreeHandle:
+    """Tree-handle entry point (`build` of build -> update -> root)."""
+    return ChunkTreeHandle(chunks)
 
 
 # ---------------------------------------------------------------------------
@@ -336,15 +433,15 @@ def state_root_bulk(state: Any) -> bytes:
 # SoA direct path (no object extraction at all — bench/production shape)
 # ---------------------------------------------------------------------------
 
-def validator_registry_root_from_columns(
+def validator_leaf_chunks(
         pubkeys: np.ndarray, withdrawal_credentials: np.ndarray,
         activation_eligibility_epoch: np.ndarray, activation_epoch: np.ndarray,
         exit_epoch: np.ndarray, withdrawable_epoch: np.ndarray,
-        slashed: np.ndarray, effective_balance: np.ndarray) -> bytes:
-    """List[Validator] root straight from SoA arrays (pubkeys [V,48] uint8,
-    withdrawal_credentials [V,32] uint8, epochs/balances [V] uint64,
-    slashed [V] bool) — zero per-validator Python. Field order matches
-    containers.Validator (spec: 0_beacon-chain.md:278-298)."""
+        slashed: np.ndarray, effective_balance: np.ndarray) -> np.ndarray:
+    """[V, 8, 32] per-validator field-chunk subtrees from SoA arrays —
+    subtree_roots_batch of the result gives each Validator's hash_tree_root.
+    Shared by the full registry root below and the incremental forest's
+    dirty-leaf recompute (resident.py patches only touched validators)."""
     V = pubkeys.shape[0]
     leaves = np.zeros((V, 8, 32), dtype=np.uint8)
     pk = np.zeros((V, 2, 32), dtype=np.uint8)
@@ -358,6 +455,23 @@ def validator_registry_root_from_columns(
     leaves[:, 6, 0] = np.asarray(slashed, dtype=np.uint8)
     leaves[:, 7, :8] = np.asarray(effective_balance, dtype=np.uint64).astype(
         "<u8").view(np.uint8).reshape(V, 8)
+    return leaves
+
+
+def validator_registry_root_from_columns(
+        pubkeys: np.ndarray, withdrawal_credentials: np.ndarray,
+        activation_eligibility_epoch: np.ndarray, activation_epoch: np.ndarray,
+        exit_epoch: np.ndarray, withdrawable_epoch: np.ndarray,
+        slashed: np.ndarray, effective_balance: np.ndarray) -> bytes:
+    """List[Validator] root straight from SoA arrays (pubkeys [V,48] uint8,
+    withdrawal_credentials [V,32] uint8, epochs/balances [V] uint64,
+    slashed [V] bool) — zero per-validator Python. Field order matches
+    containers.Validator (spec: 0_beacon-chain.md:278-298)."""
+    V = pubkeys.shape[0]
+    leaves = validator_leaf_chunks(
+        pubkeys, withdrawal_credentials, activation_eligibility_epoch,
+        activation_epoch, exit_epoch, withdrawable_epoch, slashed,
+        effective_balance)
     roots = subtree_roots_batch(leaves)
     return impl.mix_in_length(merkleize_chunk_array(roots), V)
 
@@ -420,13 +534,14 @@ def _length_chunk_words(n: int) -> np.ndarray:
     return bytes_to_words(chunk)[None, :]
 
 
-def _registry_root_words(pubkeys, wc, act_elig, act, exit_ep, withdrawable,
+def _registry_leaf_words(pubkeys, wc, act_elig, act, exit_ep, withdrawable,
                          slashed, eff_balance):
-    """Traced body: SoA validator columns -> List[Validator] root words."""
+    """Traced body: SoA validator columns -> [V, 8] per-validator root words
+    (the leaves of the registry list tree — the incremental forest builds
+    its level 0 from exactly these)."""
     import jax.numpy as jnp
 
-    from ...ops.sha256 import (
-        merkle_reduce_words, sha256_pairs_inner, subtree_roots_words)
+    from ...ops.sha256 import sha256_pairs_inner, subtree_roots_words
 
     V = pubkeys.shape[0]
     # pubkey: Bytes48 -> two chunks -> one pair-hash
@@ -443,11 +558,38 @@ def _registry_root_words(pubkeys, wc, act_elig, act, exit_ep, withdrawable,
         _u64_col_words(slashed.astype(jnp.uint64)),  # bool chunk: byte0 = 0/1
         _u64_col_words(eff_balance),
     ], axis=1)                                                    # [V, 8, 8]
-    roots = subtree_roots_words(leaves)                           # [V, 8]
+    return subtree_roots_words(leaves)                            # [V, 8]
+
+
+def _registry_root_words(pubkeys, wc, act_elig, act, exit_ep, withdrawable,
+                         slashed, eff_balance):
+    """Traced body: SoA validator columns -> List[Validator] root words."""
+    import jax.numpy as jnp
+
+    from ...ops.sha256 import merkle_reduce_words, sha256_pairs_inner
+
+    V = pubkeys.shape[0]
+    roots = _registry_leaf_words(pubkeys, wc, act_elig, act, exit_ep,
+                                 withdrawable, slashed, eff_balance)
     list_root = merkle_reduce_words(roots)                        # [8]
     mixed = jnp.concatenate([list_root[None, :],
                              jnp.asarray(_length_chunk_words(V))], axis=1)
     return sha256_pairs_inner(mixed)[0]
+
+
+def _balances_chunk_words(balances):
+    """Traced body: [V] uint64 -> [C, 8] SSZ pack chunk words (4 values per
+    32-byte chunk) — level 0 of the balances list tree."""
+    import jax.numpy as jnp
+
+    V = balances.shape[0]
+    pad = (-V) % 4
+    col = balances.astype(jnp.uint64)
+    if pad:
+        col = jnp.concatenate([col, jnp.zeros(pad, dtype=jnp.uint64)])
+    w0 = _bswap32((col & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+    w1 = _bswap32((col >> jnp.uint64(32)).astype(jnp.uint32))
+    return jnp.stack([w0, w1], axis=-1).reshape(-1, 8)            # [C, 8]
 
 
 def _balances_root_words(balances):
@@ -457,13 +599,7 @@ def _balances_root_words(balances):
     from ...ops.sha256 import merkle_reduce_words, sha256_pairs_inner
 
     V = balances.shape[0]
-    pad = (-V) % 4
-    col = balances.astype(jnp.uint64)
-    if pad:
-        col = jnp.concatenate([col, jnp.zeros(pad, dtype=jnp.uint64)])
-    w0 = _bswap32((col & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
-    w1 = _bswap32((col >> jnp.uint64(32)).astype(jnp.uint32))
-    chunks = jnp.stack([w0, w1], axis=-1).reshape(-1, 8)          # [C, 8]
+    chunks = _balances_chunk_words(balances)
     list_root = merkle_reduce_words(chunks)
     mixed = jnp.concatenate([list_root[None, :],
                              jnp.asarray(_length_chunk_words(V))], axis=1)
@@ -524,3 +660,26 @@ def registry_and_balances_roots_device(
 def _as_u64(col):
     return np.asarray(col, dtype=np.uint64) if isinstance(
         col, (np.ndarray, list, tuple)) else col
+
+
+def registry_leaf_words_device(pubkeys, withdrawal_credentials,
+                               activation_eligibility_epoch, activation_epoch,
+                               exit_epoch, withdrawable_epoch, slashed,
+                               effective_balance):
+    """[V, 8] device words of every validator's hash_tree_root — level 0 of
+    the registry's incremental forest (resident.py builds the forest from
+    these at an epoch boundary; one traced program, nothing downloads)."""
+    fn = _get_root_jit("reg_leaves", _registry_leaf_words)
+    return fn(pubkeys, withdrawal_credentials,
+              _as_u64(activation_eligibility_epoch), _as_u64(activation_epoch),
+              _as_u64(exit_epoch), _as_u64(withdrawable_epoch),
+              np.asarray(slashed, dtype=bool) if isinstance(slashed, np.ndarray)
+              else slashed,
+              _as_u64(effective_balance))
+
+
+def balances_chunk_words_device(balances):
+    """[C, 8] device words of the balances list's SSZ pack chunks — level 0
+    of the balances incremental forest."""
+    fn = _get_root_jit("bal_chunks", _balances_chunk_words)
+    return fn(_as_u64(balances))

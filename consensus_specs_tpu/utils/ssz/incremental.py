@@ -1,0 +1,270 @@
+"""Persistent, device-resident incremental Merkle forest.
+
+The per-slot full-state `hash_tree_root` (reference hot path,
+/root/reference specs/core/0_beacon-chain.md:1232-1245, Merkle loop at
+test_libs/pyspec/eth2spec/utils/merkle_minimal.py:47-54) pays O(V)
+compressions per root even when a block touched a handful of validators:
+every device path so far (bulk.merkleize_chunk_array, merkle_reduce_words)
+recomputes the whole tree from its leaves. This module keeps EVERY level of
+a tree resident as `[n_level, 8]` uint32 word arrays and re-hashes only the
+root paths of updated leaves — one batched pair-hash launch per level, so an
+update costs O(dirty * log V) compressions instead of O(V).
+
+Semantics are exactly SSZ merkleize (specs/simple-serialize.md:139-147):
+the leaf count pads virtually to the next power of two with zero chunks.
+Stored level `d` holds ceil(n / 2**d) rows; rows beyond that are virtual and
+equal `zerohashes[d]`, so the padding is never materialized. `append` grows
+the tree past the padded power of two: levels extend with zerohash rows, new
+top levels appear as the padded depth deepens, and only the appended leaves'
+root paths re-hash (tests/test_incremental_merkle.py crosses the boundary
+both ways against the full-recompute oracle).
+
+Level scatters donate the old level buffer (`donate_argnums`), so a dirty
+update rewrites rows in place instead of copying registry-scale arrays.
+Dirty index sets pad to the next power of two (duplicating the last index —
+duplicate scatters write identical values) so the jit cache sees log-many
+shapes per level, not one per dirty count.
+
+The pair hash routes through ops.sha256.pair_hash_words, making the forest
+A/B-switchable between the XLA kernel and the Pallas kernel
+(CSTPU_MERKLE_BACKEND=pallas|xla). `last_pairs_per_level` records the lanes
+dispatched by the most recent operation so tests (and benches) can assert
+the O(dirty * log V) work bound instead of trusting wall-clock.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..hash import ZERO_BYTES32
+from ..merkle import next_power_of_two, tree_depth
+from ...ops.sha256 import (_unroll_for, bytes_to_words, merkle_pair_backend_name,
+                           pair_hash_words, sha256_pairs_inner, words_to_bytes,
+                           zerohash_words)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows_donated(level: jnp.ndarray, idx: jnp.ndarray,
+                          rows: jnp.ndarray) -> jnp.ndarray:
+    return level.at[idx].set(rows)
+
+
+@jax.jit
+def _scatter_rows_undonated(level: jnp.ndarray, idx: jnp.ndarray,
+                            rows: jnp.ndarray) -> jnp.ndarray:
+    return level.at[idx].set(rows)
+
+
+def _scatter_rows(level: jnp.ndarray, idx: jnp.ndarray,
+                  rows: jnp.ndarray) -> jnp.ndarray:
+    """level.at[idx].set(rows) with the old buffer donated on accelerator
+    backends: the update rewrites the resident level in place instead of
+    copying O(n) rows. XLA:CPU keeps the undonated (copying) form — CPU
+    executables deserialized from the persistent compilation cache have
+    been observed to violate donated input/output aliasing (see
+    epoch_soa.epoch_transition_device), and tests differential on CPU."""
+    fn = (_scatter_rows_undonated if jax.default_backend() == "cpu"
+          else _scatter_rows_donated)
+    return fn(level, idx, rows)
+
+
+def _zero_rows(depth: int, k: int) -> jnp.ndarray:
+    """[k, 8] words, every row the depth-`depth` zero-subtree root."""
+    return jnp.broadcast_to(jnp.asarray(zerohash_words(depth)), (k, 8))
+
+
+@jax.jit
+def _build_levels(leaf_words: jnp.ndarray):
+    """Every level of the tree in ONE traced program — the full build (the
+    epoch-boundary degenerate case) must cost what the fused one-shot root
+    programs cost, not a per-level dispatch chain. Same per-level zerohash
+    padding as merkle_reduce_words; jit-cached per leaf count (a resident
+    deployment has one)."""
+    levels = [leaf_words]
+    level = leaf_words
+    depth = 0
+    while level.shape[0] > 1:
+        if level.shape[0] % 2:
+            level = jnp.concatenate([level, _zero_rows(depth, 1)])
+        pairs = level.reshape(-1, 16)
+        level = sha256_pairs_inner(pairs, unroll=_unroll_for(pairs.shape[0]))
+        levels.append(level)
+        depth += 1
+    return tuple(levels)
+
+
+def _pad_pow2_indices(idx: np.ndarray) -> np.ndarray:
+    """Pad an index vector to the next power of two by repeating its last
+    entry (bounds jit-cache shapes; duplicates are harmless for gather and
+    for scatters that write identical values)."""
+    m = next_power_of_two(idx.shape[0])
+    if m == idx.shape[0]:
+        return idx
+    return np.concatenate([idx, np.full(m - idx.shape[0], idx[-1], idx.dtype)])
+
+
+class IncrementalMerkleTree:
+    """All levels of one pow2-padded SSZ Merkle tree, device-resident.
+
+    build:  IncrementalMerkleTree(leaf_words)   [n, 8] uint32 big-endian words
+    update: tree.update(leaf_idx, rows_words)   O(dirty * log n) compressions
+    append: tree.append(rows_words)             grow, incl. past the padded pow2
+    root:   tree.root() -> 32 bytes             (the only device download)
+
+    List-kind callers mix the length in themselves (impl.mix_in_length), the
+    same contract as bulk.merkleize_chunk_array.
+
+    The tree takes OWNERSHIP of device-array arguments: level buffers are
+    donated back into scatters on update, so a jnp `leaf_words`/`rows_words`
+    must not be reused by the caller afterwards (numpy inputs are copied on
+    upload and stay valid).
+    """
+
+    def __init__(self, leaf_words, pair_fn=None):
+        leaf_words = jnp.asarray(leaf_words, jnp.uint32)
+        assert leaf_words.ndim == 2 and leaf_words.shape[1] == 8, \
+            leaf_words.shape
+        self._pair_fn = pair_fn          # None = ops.sha256.pair_hash_words
+        self.last_pairs_per_level: List[int] = []
+        self.total_pairs_hashed = 0
+        self.builds = 0
+        self.levels: List[jnp.ndarray] = [leaf_words]
+        self._build()
+
+    @property
+    def n(self) -> int:
+        return int(self.levels[0].shape[0])
+
+    @property
+    def depth(self) -> int:
+        return len(self.levels) - 1
+
+    def _hash(self, pairs: jnp.ndarray) -> jnp.ndarray:
+        fn = self._pair_fn if self._pair_fn is not None else pair_hash_words
+        return fn(pairs)
+
+    def _count(self, depth: int, lanes: int) -> None:
+        while len(self.last_pairs_per_level) <= depth:
+            self.last_pairs_per_level.append(0)
+        self.last_pairs_per_level[depth] += lanes
+        self.total_pairs_hashed += lanes
+
+    # -- full build (the epoch-boundary degenerate case) --------------------
+
+    def _build(self) -> None:
+        self.builds += 1
+        self.last_pairs_per_level = []
+        level = self.levels[0]
+        del self.levels[1:]
+        depth = tree_depth(level.shape[0])
+        if depth == 0:
+            return
+        if self._pair_fn is None and merkle_pair_backend_name() == "xla":
+            # default kernel: the whole build is one traced program
+            self.levels = list(_build_levels(level))
+            for d in range(depth):
+                self._count(d, (self.levels[d].shape[0] + 1) // 2)
+            return
+        # explicit/Pallas backends keep the per-level host loop (the A/B
+        # boundary lives at the per-launch pair hash)
+        for d in range(depth):
+            if level.shape[0] % 2:
+                level = jnp.concatenate([level, _zero_rows(d, 1)])
+            pairs = level.reshape(-1, 16)
+            level = self._hash(pairs)
+            self._count(d, pairs.shape[0])
+            self.levels.append(level)
+
+    # -- incremental paths --------------------------------------------------
+
+    def update(self, leaf_idx, rows_words) -> None:
+        """Overwrite leaves and re-hash only their root paths.
+
+        leaf_idx: [k] unique in-range ints; rows_words: [k, 8] uint32."""
+        idx = np.asarray(leaf_idx, dtype=np.int32).reshape(-1)
+        rows = jnp.asarray(rows_words, jnp.uint32).reshape(-1, 8)
+        assert idx.shape[0] == rows.shape[0], (idx.shape, rows.shape)
+        if idx.shape[0] == 0:
+            self.last_pairs_per_level = []
+            return
+        dirty = np.unique(idx)
+        assert dirty.shape[0] == idx.shape[0], "duplicate leaf indices"
+        assert 0 <= dirty[0] and dirty[-1] < self.n, \
+            f"leaf index out of range (n={self.n}); grow via append()"
+        self.levels[0] = _scatter_rows(self.levels[0], jnp.asarray(idx), rows)
+        self.last_pairs_per_level = []
+        self._rehash_paths(dirty)
+
+    def append(self, rows_words) -> None:
+        """Append leaves, growing past the padded power of two when needed:
+        every level extends with virtual-zero rows, new top levels appear as
+        the padded depth deepens, and only the appended leaves' root paths
+        re-hash (their ancestor chains cover every row whose value changes,
+        including the old odd tails that used to pair with a zerohash)."""
+        rows = jnp.asarray(rows_words, jnp.uint32).reshape(-1, 8)
+        k = int(rows.shape[0])
+        if k == 0:
+            self.last_pairs_per_level = []
+            return
+        old_n = self.n
+        new_n = old_n + k
+        self.levels[0] = (rows if old_n == 0
+                          else jnp.concatenate([self.levels[0], rows]))
+        for d in range(1, tree_depth(new_n) + 1):
+            n_d = (new_n + (1 << d) - 1) >> d
+            if d < len(self.levels):
+                short = n_d - self.levels[d].shape[0]
+                if short > 0:
+                    self.levels[d] = jnp.concatenate(
+                        [self.levels[d], _zero_rows(d, short)])
+            else:
+                # rows not on an appended leaf's root path cover only
+                # virtual zero leaves, for which zerohash[d] IS the value
+                self.levels.append(_zero_rows(d, n_d))
+        self.last_pairs_per_level = []
+        self._rehash_paths(np.arange(old_n, new_n, dtype=np.int32))
+
+    def _rehash_paths(self, dirty: np.ndarray) -> None:
+        """Re-hash the ancestor rows of `dirty` leaves, one batched pair-hash
+        launch per level (dirty set padded to pow2 to bound jit shapes)."""
+        for d in range(self.depth):
+            parents = np.unique(dirty >> 1)
+            lanes = _pad_pow2_indices(parents)
+            level = self.levels[d]
+            n_d = level.shape[0]
+            left = level[jnp.asarray(lanes * 2)]
+            ri = lanes * 2 + 1
+            right = level[jnp.asarray(np.minimum(ri, n_d - 1))]
+            virtual = ri >= n_d            # odd tail: right child is zerohash
+            if virtual.any():
+                right = jnp.where(jnp.asarray(virtual)[:, None],
+                                  _zero_rows(d, 1), right)
+            digests = self._hash(jnp.concatenate([left, right], axis=1))
+            self.levels[d + 1] = _scatter_rows(
+                self.levels[d + 1], jnp.asarray(lanes), digests)
+            self._count(d, int(lanes.shape[0]))
+            dirty = parents
+
+    # -- root ---------------------------------------------------------------
+
+    def root(self) -> bytes:
+        """The pow2-padded merkleize root — bit-identical to
+        bulk.merkleize_chunk_array over the equivalent chunk matrix."""
+        if self.n == 0:
+            return ZERO_BYTES32
+        return words_to_bytes(np.asarray(self.levels[-1][0])).tobytes()
+
+
+def tree_from_chunks(chunks: np.ndarray,
+                     pair_fn=None) -> IncrementalMerkleTree:
+    """[n, 32] uint8 chunk matrix -> forest (byte-level convenience)."""
+    chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+    assert chunks.ndim == 2 and chunks.shape[1] == 32, chunks.shape
+    words = (np.zeros((0, 8), np.uint32) if chunks.shape[0] == 0
+             else bytes_to_words(chunks))   # reshape of 0 rows is ill-defined
+    return IncrementalMerkleTree(words, pair_fn=pair_fn)

@@ -196,6 +196,35 @@ def test_subtree_roots_memo_hit_is_writable_copy():
     np.testing.assert_array_equal(again, first)
 
 
+def test_memo_put_cap_overflow_clears_wholesale(monkeypatch):
+    """_MEMO_MAX_BYTES exceeded -> the next insert clears the memo wholesale
+    and repopulates from the live set; evicted content recomputes correctly
+    (never a stale or missing root)."""
+    saved_memo = dict(bulk._memo)
+    saved_bytes = bulk._memo_bytes
+    try:
+        bulk._memo.clear()
+        bulk._memo_bytes = 0
+        # one 64-chunk entry keys at 2048B (+64 overhead) — the cap admits
+        # exactly one, so every later insert lands on the overflow path
+        monkeypatch.setattr(bulk, "_MEMO_MAX_BYTES", 1000)
+        rng = np.random.default_rng(21)
+        mats = [rng.integers(0, 256, (64, 32), dtype=np.uint8)
+                for _ in range(3)]
+        roots = [bulk.merkleize_chunk_array(m) for m in mats]
+        assert len(bulk._memo) == 1               # overflow evicted the rest
+        assert bulk._memo_bytes <= 2048 + 32 + 64
+        assert ("mca", mats[-1].tobytes()) in bulk._memo
+        from consensus_specs_tpu.utils.merkle import merkleize_chunks
+        for m, r in zip(mats, roots):             # recompute, bit-identical
+            assert bulk.merkleize_chunk_array(m) == r == merkleize_chunks(
+                [m[i].tobytes() for i in range(64)])
+    finally:
+        bulk._memo.clear()
+        bulk._memo.update(saved_memo)
+        bulk._memo_bytes = saved_bytes
+
+
 def test_memo_size_gate_routes_large_inputs_around_cache():
     """Matrices above the per-entry key cap bypass the memo (no insertion,
     no thrash) and stay deterministic across calls."""

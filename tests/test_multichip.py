@@ -40,10 +40,12 @@ def test_epoch_transition_sharded_equals_single(mesh, seed):
         cfg, V, np.random.default_rng(seed), random_eligibility=True,
         random_slashed_balances=True)
 
+    # shard (device_put copies) BEFORE the single-device run: the direct
+    # epoch_transition_device call donates `cols`
+    cols_s, scal_s, inp_s = shard_epoch_state(mesh, cols, scal, inp)
     single = epoch_transition_device(cfg, cols, scal, inp)
     jax.block_until_ready(single)
 
-    cols_s, scal_s, inp_s = shard_epoch_state(mesh, cols, scal, inp)
     sharded = jax.jit(
         lambda c, s, i: epoch_transition_device(cfg, c, s, i)
     )(cols_s, scal_s, inp_s)
@@ -143,9 +145,10 @@ def test_hierarchical_mesh_epoch_equals_single():
     cfg = EpochConfig.from_spec(spec)
     cols, scal, inp = synthetic_epoch_state(
         cfg, 64 * N_DEV, np.random.default_rng(9), random_eligibility=True)
-    single = jax.device_get(epoch_transition_device(cfg, cols, scal, inp))
+    # shard first: the direct single-device call donates `cols`
     cols_s = shard_hierarchical(hmesh, cols)
     scal_s = shard_hierarchical(hmesh, scal)  # 0-d scalars replicate
+    single = jax.device_get(epoch_transition_device(cfg, cols, scal, inp))
     # per-shard tables replicate; [V] facts shard with the columns
     import jax as _jax
     from jax.sharding import NamedSharding, PartitionSpec

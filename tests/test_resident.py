@@ -97,6 +97,64 @@ def test_resident_fallback_on_registry_mutating_block(spec):
     assert serialize(ref, spec.BeaconState) == serialize(res, spec.BeaconState)
 
 
+def test_fallback_is_incremental_and_grows_forest(spec):
+    """A registry-mutating block must NOT throw the registry-scale trees
+    away: the same incremental forests survive the fallback with
+    O(dirty·log V) pair-hash lanes, and a deposit block append-grows them
+    across the padded power-of-two boundary — roots bit-equal to the
+    object model throughout."""
+    from consensus_specs_tpu.utils.merkle import tree_depth
+
+    state = factories.seed_genesis_state(spec, 4 * spec.SLOTS_PER_EPOCH)
+    factories.advance_slots(spec, state, 2)
+    ref, res = deepcopy(state), deepcopy(state)
+    core = ResidentCore(spec, res)
+    try:
+        core._state_root(res)                    # build the forests
+        f_reg, f_bal = core._reg_forest, core._bal_forest
+        V = len(ref.validator_registry)
+        assert f_reg is not None and f_reg.builds == 1 and f_reg.n == V
+        assert V & (V - 1) == 0, "seed V must be a power of two for the test"
+
+        # -- slashing: dirties a handful of validators -----------------------
+        with core.suspended():
+            block = factories.empty_block_next(spec, ref)
+            block.body.proposer_slashings.append(
+                factories.double_proposal(spec, ref))
+            spec.process_slots(ref, block.slot)
+            spec.process_block(ref, block)
+        core.state_transition(res, block)
+        assert core._reg_forest is f_reg and core._bal_forest is f_bal
+        assert f_reg.builds == 1                 # updated in place, no rebuild
+        # the slashing touches one validator's registry leaf (plus pow2
+        # index padding); nowhere near the V-leaf rebuild
+        assert 0 < sum(f_reg.last_pairs_per_level) <= 2 * 2 * f_reg.depth
+        assert hash_tree_root(ref) == core._state_root(res)
+
+        # -- deposit: grows V -> V+1 across the padded power of two ----------
+        with core.suspended():
+            # stage the deposit BEFORE building the block: it plants eth1
+            # data into the state, and empty_block seals the parent header
+            # with the state root as of build time
+            deposit = factories.stage_deposit(
+                spec, ref, V, spec.MAX_EFFECTIVE_BALANCE)
+            # the planted eth1 data is pre-block chain context BOTH paths
+            # need (snapshot before ref's transition can vote on it)
+            res.latest_eth1_data = deepcopy(ref.latest_eth1_data)
+            block = factories.empty_block_next(spec, ref)
+            block.body.deposits.append(deposit)
+            spec.process_slots(ref, block.slot)
+            spec.process_block(ref, block)
+        core.state_transition(res, block)
+        assert core._reg_forest is f_reg and f_reg.n == V + 1
+        assert f_reg.depth == tree_depth(V + 1) > tree_depth(V)
+        assert len(core._pk_np) == V + 1         # identity columns grew too
+        assert hash_tree_root(ref) == core._state_root(res)
+    finally:
+        core.exit()
+    assert serialize(ref, spec.BeaconState) == serialize(res, spec.BeaconState)
+
+
 def test_resident_root_backend_declines_foreign_state(spec):
     state = factories.seed_genesis_state(spec, 2 * spec.SLOTS_PER_EPOCH)
     res = deepcopy(state)

@@ -243,18 +243,24 @@ def main():
     cols, scal, inp = synthetic_epoch_state(cfg, V, np.random.default_rng(42),
                                             slashed_p=0.001, incl_delay_max=32,
                                             random_slashed_balances=True)
-    sync(epoch_transition_device(cfg, cols, scal, inp))
+    # epoch_transition_device donates the columns on TPU: hold the host copy
+    # needed below, then chain each call's output columns into the next
+    elig_host = np.asarray(cols.activation_eligibility_epoch, dtype=np.uint64)
+    out = epoch_transition_device(cfg, cols, scal, inp)
+    sync(out)
+    cols = out[0]
     ts = []
     for _ in range(3):
         t0 = time.perf_counter()
-        sync(epoch_transition_device(cfg, cols, scal, inp))
+        out = epoch_transition_device(cfg, cols, scal, inp)
+        sync(out)
+        cols = out[0]
         ts.append(time.perf_counter() - t0)
     print(f"epoch full: {min(ts)*1e3:.0f} ms", flush=True)
 
     import jax
     # isolate the activation-queue sort (suspected dominant term)
-    elig = np.asarray(cols.activation_eligibility_epoch, dtype=np.uint64) \
-        if hasattr(cols, "activation_eligibility_epoch") else None
+    elig = elig_host
     if elig is not None:
         key = jnp.asarray(elig)
         f_sort = jax.jit(lambda k: jnp.argsort(k, stable=True))
