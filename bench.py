@@ -211,6 +211,64 @@ def bench_merkle_backend_ab():
     return out
 
 
+def bench_scalar_mul_ab():
+    """A/B the scalar-mul backends (CSTPU_SCALAR_MUL=window|double_add) on
+    the two hot shapes: the fixed ~509-bit G2 cofactor clearing (the
+    hash_to_g2 tail — ~95% of hash-to-curve time) and a traced 256-bit
+    scalar. Per backend and shape: steady-state ms plus the dependent
+    jac_add chain length (ops/scalar_mul.sequential_adds — the latency
+    currency the windowed backend exists to cut). Results are checked
+    value-equal across backends against the host bignum before anything
+    is timed."""
+    import jax.numpy as jnp
+    from consensus_specs_tpu.crypto import bls12_381 as gt
+    from consensus_specs_tpu.ops import bls_jax as BJ
+    from consensus_specs_tpu.ops import fq_tower as T
+    from consensus_specs_tpu.ops import scalar_mul as SM
+
+    batch = 8
+    pts = [gt.ec_mul(gt.G2_GEN, 7 * i + 3) for i in range(batch)]
+    arr = np.stack([BJ.g2_to_limbs(p) for p in pts])
+    x, y = jnp.asarray(arr[:, 0]), jnp.asarray(arr[:, 1])
+    _sync((x, y))
+    w = SM.scalar_mul_window()
+    k256 = int.from_bytes(bytes(range(11, 43)), "big")   # fixed 256-bit
+    shapes = (("cofactor", gt.G2_COFACTOR, gt.G2_COFACTOR.bit_length()),
+              ("k256", k256, 256))
+    out = {"batch": batch, "window_w": w}
+    values = {}
+    for name in ("double_add", "window"):
+        SM.set_scalar_mul_backend(name)
+        try:
+            for label, k, nbits in shapes:
+                gx, gy, ginf = BJ.g2_scalar_mul(x, y, k, nbits=nbits)
+                got = [None if bool(i) else
+                       (T.fq2_from_limbs(px), T.fq2_from_limbs(py))
+                       for px, py, i in zip(np.asarray(gx), np.asarray(gy),
+                                            np.asarray(ginf))]
+                values[(label, name)] = got
+                iters = 3
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    _sync(BJ.g2_scalar_mul(x, y, k, nbits=nbits))
+                out[f"{label}_{name}_ms"] = round(
+                    (time.perf_counter() - t0) / iters * 1e3, 2)
+                out[f"{label}_{name}_seq_adds"] = SM.sequential_adds(
+                    name, nbits, w)
+        finally:
+            SM.set_scalar_mul_backend(None)
+    for label, k, nbits in shapes:
+        want = [gt.ec_mul(p, k) for p in pts]
+        assert values[(label, "window")] == want, f"{label}: window != bignum"
+        assert values[(label, "double_add")] == want, \
+            f"{label}: double_add != bignum"
+        ratio = (out[f"{label}_double_add_seq_adds"]
+                 / out[f"{label}_window_seq_adds"])
+        out[f"{label}_seq_add_ratio"] = round(ratio, 2)
+        assert ratio >= 2.5, f"{label}: sequential-add cut only {ratio:.2f}x"
+    return out
+
+
 def _stage_attestation_pairs(n_groups, n_distinct=8):
     """See ops/bls_jax.stage_example_groups (shared with the mesh tests and
     dryrun_multichip so all three present identical program shapes)."""
@@ -695,36 +753,69 @@ _T_START = time.perf_counter()
 _CPU_FALLBACK = False   # set when the probe demoted a dead TPU run to CPU
 
 
+def _run_probe_child(code: str, timeout_s: float, env=None):
+    """Run `code` in a child python; on timeout, SIGKILL the child's whole
+    process group and reap with a BOUNDED wait. Returns (rc, stdout,
+    stderr); rc None means the child hung.
+
+    subprocess.run(timeout=...) is NOT enough here: its TimeoutExpired
+    path kills the child and then waits UNBOUNDEDLY for it to exit, and a
+    child wedged inside the TPU relay's native code can sit in
+    uninterruptible sleep where even SIGKILL doesn't take effect — which
+    is how BENCH_r04/r05 turned a 180 s probe timeout into rc=2 with no
+    JSON. A bounded reap means the parent always gets its hang verdict
+    back and can fall through to the CPU smoke shape (the at-worst-leaked
+    zombie is the driver's to collect, not a reason to drop the bench
+    artifact)."""
+    import signal
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, start_new_session=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            proc.communicate(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass   # uninterruptible child: leak it, keep the bench alive
+        return None, "", ""
+
+
 def _probe_backend(timeout_s: int = 180) -> None:
     """Probe the device backend in a subprocess with a hard timeout; on
     a dead/wedged accelerator, fall back to the CPU smoke path.
 
     A wedged TPU relay hangs `jax.devices()` indefinitely inside
     uninterruptible native code; probing in a subprocess converts a
-    40-minute silent hang into a quick, diagnosable signal. BENCH_r05
-    then exited 2 on that signal and produced no JSON at all — now the
-    probe demotes the run to the CPU smoke configuration (the same path
+    40-minute silent hang into a quick, diagnosable signal, and the hang
+    demotes the run to the CPU smoke configuration (the same path
     `make bench-cpu` pins) so `make bench` always emits a parseable
     artifact; only an unreachable CPU backend (interpreter/numpy broken)
-    still aborts."""
-    import subprocess
+    still aborts. The CPU re-probe pins JAX_PLATFORMS=cpu in the child's
+    ENVIRONMENT, not in code: a wedged relay can hang `import jax` itself
+    (plugin discovery), so an in-code config.update would never run."""
     import sys
 
     def probe(force_cpu: bool) -> str:
         code = "import jax; print(jax.devices()[0].platform)"
+        env = None
         if force_cpu:
-            code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
-                    + code)
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True, timeout=timeout_s, text=True)
-        except subprocess.TimeoutExpired:
+            env = dict(os.environ, JAX_PLATFORMS="cpu", CSTPU_BENCH_CPU="1")
+        rc, out, err = _run_probe_child(code, timeout_s, env=env)
+        if rc is None:
             return f"probe hung > {timeout_s}s (relay wedged?)"
-        if proc.returncode == 0:
-            _progress(f"backend up: {proc.stdout.strip()}")
+        if rc == 0:
+            _progress(f"backend up: {out.strip()}")
             return ""
-        reason = (proc.stderr or "").strip().splitlines()[-1:] or ["unknown"]
+        reason = (err or "").strip().splitlines()[-1:] or ["unknown"]
         return f"init failed: {reason[0]}"
 
     cpu_only = os.environ.get("CSTPU_BENCH_CPU") == "1"
@@ -740,6 +831,7 @@ def _probe_backend(timeout_s: int = 180) -> None:
             global V_DEVICE, V_STATE, N_ATTESTATIONS, _CPU_FALLBACK
             _CPU_FALLBACK = True
             os.environ["CSTPU_BENCH_CPU"] = "1"   # for child processes
+            os.environ["JAX_PLATFORMS"] = "cpu"   # ...even if they import jax
             import jax
             jax.config.update("jax_platforms", "cpu")
             V_DEVICE = min(V_DEVICE, 65536)
@@ -852,6 +944,13 @@ def main():
     if ab is not None:
         _progress("pair-hash A/B: xla %(xla_ms).1f ms, pallas %(pallas_ms).1f "
                   "ms @ %(lanes)d lanes" % ab)
+    smab = _device("scalar-mul A/B", bench_scalar_mul_ab)
+    if smab is not None:
+        _progress("scalar-mul A/B (w=%(window_w)d): cofactor "
+                  "%(cofactor_window_ms).1f ms / %(cofactor_window_seq_adds)d "
+                  "adds vs %(cofactor_double_add_ms).1f ms / "
+                  "%(cofactor_double_add_seq_adds)d adds; k256 "
+                  "%(k256_window_ms).1f vs %(k256_double_add_ms).1f ms" % smab)
     bls_res = _device("BLS batch", bench_bls_device)
     t_bls, t_py_verify = bls_res if bls_res is not None else (None, None)
     if t_bls is not None:
@@ -887,6 +986,15 @@ def main():
     if ab is not None:
         parts.append("pair-hash A/B xla %.1f / pallas %.1f ms @ %d lanes" % (
             ab["xla_ms"], ab["pallas_ms"], ab["lanes"]))
+    if smab is not None:
+        parts.append(
+            "scalar-mul A/B w=%d: cofactor %d->%d seq adds (%.1f/%.1f ms), "
+            "256-bit %d->%d (%.1f/%.1f ms)" % (
+                smab["window_w"], smab["cofactor_double_add_seq_adds"],
+                smab["cofactor_window_seq_adds"],
+                smab["cofactor_double_add_ms"], smab["cofactor_window_ms"],
+                smab["k256_double_add_seq_adds"], smab["k256_window_seq_adds"],
+                smab["k256_double_add_ms"], smab["k256_window_ms"]))
     if t_bls is not None:
         parts.append("%d-agg-verify %.1f ms = %.0f aggverify/s/chip" % (
             N_ATTESTATIONS, t_bls * 1e3, N_ATTESTATIONS / t_bls))
@@ -921,6 +1029,8 @@ def main():
         record["incremental_root"] = inc
     if ab is not None:
         record["merkle_backend_ab"] = ab
+    if smab is not None:
+        record["scalar_mul_ab"] = smab
     print(json.dumps(record))
 
 

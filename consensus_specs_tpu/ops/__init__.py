@@ -1,1 +1,2 @@
-"""TPU array kernels: batched SHA-256, swap-or-not shuffle, BLS12-381 field ops."""
+"""TPU array kernels: batched SHA-256, swap-or-not shuffle, BLS12-381 field
+ops, and windowed scalar multiplication (scalar_mul)."""

@@ -35,6 +35,11 @@ TPU-first design notes:
 - Verification is product-of-Miller-loops with ONE shared final
   exponentiation (specs/bls_signature.md:139-146), batched over the pair
   axis; aggregation is a log-depth tree of batched Jacobian adds.
+- Scalar multiplication (sign/privtopub and the G2 cofactor clearing in
+  hash_to_g2_batch) is windowed signed-digit by default — host-recoded odd
+  digits gathered from a device odd-multiple table, ~3.6x fewer dependent
+  jac_adds than double-and-add (ops/scalar_mul.py; CSTPU_SCALAR_MUL=
+  double_add keeps the per-bit reference path as the oracle).
 - Everything is jit-compiled; shapes are static per pair-count/committee
   size and jax's jit cache keys on them.
 
@@ -45,6 +50,7 @@ checks the 2019 spec performs at the boundary); mid-loop exceptional cases
 """
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence, Tuple
 from types import SimpleNamespace
 
@@ -54,6 +60,12 @@ from ..crypto import bls12_381 as gt
 from . import decompress as decomp
 from . import fq as F
 from . import fq_tower as T
+from . import scalar_mul as SM
+# The generic Jacobian point-op layer lives in ops/scalar_mul.py (with both
+# scalar-mul backends); re-exported here for the aggregation trees below and
+# the differential tests.
+from .scalar_mul import (jac_add, jac_double, jac_infinity,  # noqa: F401
+                         jac_scalar_mul, jac_to_affine)
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -84,100 +96,6 @@ G2_OPS = SimpleNamespace(
     mul=T.fq2_mul, sqr=T.fq2_sqr, add=T.fq2_add, sub=T.fq2_sub, neg=T.fq2_neg,
     inv=T.fq2_inv, select=T.fq2_select, is_zero=T.fq2_is_zero,
     zeros=T.fq2_zeros, ones=T.fq2_ones, val_ndim=2)
-
-
-def jac_infinity(fo, batch=()):
-    """The point at infinity: (0, 1, 0)."""
-    return (fo.zeros(batch), fo.ones(batch), fo.zeros(batch))
-
-
-def jac_double(fo, p):
-    """2P in Jacobian coordinates, a = 0 curve. Handles P = O and 2-torsion
-    (Y = 0) via Z3 = 2YZ = 0."""
-    X, Y, Z = p
-    A = fo.sqr(X)
-    B = fo.sqr(Y)
-    C = fo.sqr(B)
-    D = fo.sub(fo.sqr(fo.add(X, B)), fo.add(A, C))
-    D = fo.add(D, D)
-    E = fo.add(fo.add(A, A), A)
-    Fv = fo.sqr(E)
-    X3 = fo.sub(Fv, fo.add(D, D))
-    C8 = fo.add(C, C)
-    C8 = fo.add(C8, C8)
-    C8 = fo.add(C8, C8)
-    Y3 = fo.sub(fo.mul(E, fo.sub(D, X3)), C8)
-    Z3 = fo.mul(Y, Z)
-    Z3 = fo.add(Z3, Z3)
-    return (X3, Y3, Z3)
-
-
-def jac_add(fo, p1, p2):
-    """P1 + P2 in Jacobian coordinates with full special-case handling
-    (either infinity, P1 == P2 -> double, P1 == -P2 -> infinity), resolved
-    by selects so the op is branch-free and batchable."""
-    X1, Y1, Z1 = p1
-    X2, Y2, Z2 = p2
-    inf1 = fo.is_zero(Z1)
-    inf2 = fo.is_zero(Z2)
-    Z1Z1 = fo.sqr(Z1)
-    Z2Z2 = fo.sqr(Z2)
-    U1 = fo.mul(X1, Z2Z2)
-    U2 = fo.mul(X2, Z1Z1)
-    S1 = fo.mul(fo.mul(Y1, Z2), Z2Z2)
-    S2 = fo.mul(fo.mul(Y2, Z1), Z1Z1)
-    H = fo.sub(U2, U1)
-    Rr = fo.sub(S2, S1)
-    Rr = fo.add(Rr, Rr)
-    h_zero = fo.is_zero(H)
-    r_zero = fo.is_zero(Rr)
-    H2 = fo.add(H, H)
-    I = fo.sqr(H2)
-    J = fo.mul(H, I)
-    V = fo.mul(U1, I)
-    X3 = fo.sub(fo.sub(fo.sqr(Rr), J), fo.add(V, V))
-    S1J = fo.mul(S1, J)
-    Y3 = fo.sub(fo.mul(Rr, fo.sub(V, X3)), fo.add(S1J, S1J))
-    Z3 = fo.mul(fo.sub(fo.sqr(fo.add(Z1, Z2)), fo.add(Z1Z1, Z2Z2)), H)
-    out = (X3, Y3, Z3)
-    dbl = jac_double(fo, p1)
-    batch = X1.shape[:-fo.val_ndim]
-    inf = jac_infinity(fo, batch)
-    both = ~inf1 & ~inf2
-    out = tuple(fo.select(both & h_zero & r_zero, d, o) for d, o in zip(dbl, out))
-    out = tuple(fo.select(both & h_zero & ~r_zero, i, o) for i, o in zip(inf, out))
-    out = tuple(fo.select(inf1, b, o) for b, o in zip(p2, out))
-    out = tuple(fo.select(inf2, a, o) for a, o in zip(p1, out))
-    return out
-
-
-def jac_scalar_mul(fo, aff, bits):
-    """[k]P for affine P, k given MSB-first as a [nbits] uint8 array (traced
-    data, static length). Double-and-add over a fori_loop; the add handles
-    the initial infinity accumulator."""
-    x, y = aff
-    batch = x.shape[:-fo.val_ndim]
-    lifted = (x, y, fo.ones(batch))
-
-    def body(i, acc):
-        acc = jac_double(fo, acc)
-        added = jac_add(fo, acc, lifted)
-        take = bits[i] == 1
-        return tuple(fo.select(take, a, o) for a, o in zip(added, acc))
-
-    acc0 = jac_infinity(fo, batch)
-    n = bits.shape[0]
-    return jax.lax.fori_loop(0, n, body, acc0)
-
-
-def jac_to_affine(fo, p):
-    """Jacobian -> (x, y, is_infinity). x/y are garbage when infinite."""
-    X, Y, Z = p
-    zi = fo.inv(Z)
-    zi2 = fo.sqr(zi)
-    x = fo.mul(X, zi2)
-    y = fo.mul(Y, fo.mul(zi2, zi))
-    return x, y, fo.is_zero(Z)
 
 
 # ---------------------------------------------------------------------------
@@ -536,7 +454,66 @@ def _g2_scalar_mul(aff_x, aff_y, bits):
     return jac_to_affine(G2_OPS, pt)
 
 
-_G2_COFACTOR_BITS = None   # lazy: MSB-first bits of the ~508-bit cofactor
+@jax.jit
+def _g1_scalar_mul(aff_x, aff_y, bits):
+    pt = jac_scalar_mul(G1_OPS, (aff_x, aff_y), bits)
+    return jac_to_affine(G1_OPS, pt)
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def _g2_scalar_mul_win(aff_x, aff_y, idx, sign, correction, w):
+    pt = SM.windowed_scalar_mul(G2_OPS, (aff_x, aff_y), idx, sign,
+                                correction, w=w)
+    return jac_to_affine(G2_OPS, pt)
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def _g1_scalar_mul_win(aff_x, aff_y, idx, sign, correction, w):
+    pt = SM.windowed_scalar_mul(G1_OPS, (aff_x, aff_y), idx, sign,
+                                correction, w=w)
+    return jac_to_affine(G1_OPS, pt)
+
+
+def _scalar_mul_dispatch(win_jit, da_jit, aff_x, aff_y, k: int, nbits: int):
+    """One backend dispatch (CSTPU_SCALAR_MUL) shared by G1 and G2: recode
+    on host (memoized exact int arithmetic), ship the digits as tiny traced
+    arrays — the jit cache keys only on (batch shape, m, w)."""
+    if SM.scalar_mul_backend_name() == "window":
+        w = SM.scalar_mul_window()
+        rec = SM.recode_signed_windows(int(k), nbits, w)
+        return win_jit(aff_x, aff_y, jnp.asarray(rec.idx),
+                       jnp.asarray(rec.sign),
+                       jnp.asarray(np.bool_(rec.correction)), w=w)
+    return da_jit(aff_x, aff_y, jnp.asarray(SM.scalar_bits(int(k), nbits)))
+
+
+def g1_scalar_mul(aff_x, aff_y, k: int, nbits: int = 256):
+    """[k]P batched over affine G1 points (k shared across the batch) ->
+    (x, y, is_inf) affine, backend per CSTPU_SCALAR_MUL."""
+    return _scalar_mul_dispatch(_g1_scalar_mul_win, _g1_scalar_mul,
+                                aff_x, aff_y, k, nbits)
+
+
+def g2_scalar_mul(aff_x, aff_y, k: int, nbits: int = 256):
+    """G2 twin of g1_scalar_mul."""
+    return _scalar_mul_dispatch(_g2_scalar_mul_win, _g2_scalar_mul,
+                                aff_x, aff_y, k, nbits)
+
+
+# Cofactor staging, precomputed at import (static numpy): _G2_COFACTOR_BITS
+# is the memoized bit array the double_add dispatch re-reads per call, and
+# the recode warm-up fills the same memo the windowed dispatch hits — so
+# neither path recodes the ~507-bit constant at request time. The warm-up
+# tolerates a bad CSTPU_SCALAR_WINDOW: an invalid env var must surface at
+# dispatch time as a ValueError, not make the whole backend unimportable
+# (double_add never even reads the width).
+_G2_COFACTOR_NBITS = gt.G2_COFACTOR.bit_length()
+_G2_COFACTOR_BITS = SM.scalar_bits(gt.G2_COFACTOR, _G2_COFACTOR_NBITS)
+try:
+    SM.recode_signed_windows(gt.G2_COFACTOR, _G2_COFACTOR_NBITS,
+                             SM.scalar_mul_window())
+except ValueError:
+    pass
 _HASH_BATCH_MIN = 8        # below this, per-message host bignum wins
 
 
@@ -544,34 +521,26 @@ def hash_to_g2_batch(requests):
     """[(message_hash, domain)] -> [(Fq2, Fq2)] == gt.hash_to_g2 per pair.
 
     The data-dependent try-and-increment search stays host-side (cheap:
-    a few Fq2 sqrts); the ~508-bit cofactor multiplication — the ~95% of
-    gt.hash_to_g2's host bignum time — runs as ONE batched device
-    double-and-add over all messages."""
-    global _G2_COFACTOR_BITS
+    a few Fq2 sqrts); the ~507-bit cofactor multiplication — the ~95% of
+    gt.hash_to_g2's host bignum time — runs as ONE batched device scalar
+    mul over all messages (windowed signed-digit by default: 135 vs 507
+    sequential adds, ops/scalar_mul.py; the digits are module-load
+    constants, nothing about the scalar is decomposed at trace time)."""
     if not requests:
         return []
-    if _G2_COFACTOR_BITS is None:
-        _G2_COFACTOR_BITS = _scalar_bits(
-            gt.G2_COFACTOR, width=gt.G2_COFACTOR.bit_length())
     cands = [gt.hash_to_g2_candidate(mh, dom) for mh, dom in requests]
     n = len(cands)
     pad = _next_pow2(n)
     cands = cands + [cands[-1]] * (pad - n)   # pow2 pad: log-many jit shapes
     arr = np.stack([g2_to_limbs(c) for c in cands])          # [pad, 2, 2, L]
-    x, y, inf = _g2_scalar_mul(jnp.asarray(arr[:, 0]), jnp.asarray(arr[:, 1]),
-                               jnp.asarray(_G2_COFACTOR_BITS))
+    x, y, inf = g2_scalar_mul(jnp.asarray(arr[:, 0]), jnp.asarray(arr[:, 1]),
+                              gt.G2_COFACTOR, nbits=_G2_COFACTOR_NBITS)
     x, y, inf = np.asarray(x)[:n], np.asarray(y)[:n], np.asarray(inf)[:n]
     out = []
     for k in range(len(requests)):
         assert not bool(inf[k]), "cofactor-cleared hash point cannot be infinity"
         out.append((T.fq2_from_limbs(x[k]), T.fq2_from_limbs(y[k])))
     return out
-
-
-@jax.jit
-def _g1_scalar_mul(aff_x, aff_y, bits):
-    pt = jac_scalar_mul(G1_OPS, (aff_x, aff_y), bits)
-    return jac_to_affine(G1_OPS, pt)
 
 
 # ---------------------------------------------------------------------------
@@ -589,8 +558,9 @@ def g2_to_limbs(pt) -> np.ndarray:
 
 
 def _scalar_bits(k: int, width: int = 256) -> np.ndarray:
-    return np.array([(k >> (width - 1 - i)) & 1 for i in range(width)],
-                    dtype=np.uint8)
+    """Memoized MSB-first bit staging (ops/scalar_mul.scalar_bits) — the
+    per-call 256-entry Python list this used to rebuild is gone."""
+    return SM.scalar_bits(int(k), width)
 
 
 def _next_pow2(n: int) -> int:
@@ -955,8 +925,7 @@ class JaxBackend:
         if k == 0:
             return gt.compress_g2(None)
         hx, hy = g2_to_limbs(h)
-        x, y, inf = _g2_scalar_mul(jnp.asarray(hx), jnp.asarray(hy),
-                                   jnp.asarray(_scalar_bits(k)))
+        x, y, inf = g2_scalar_mul(jnp.asarray(hx), jnp.asarray(hy), k)
         assert not bool(np.asarray(inf))
         return gt.compress_g2((T.fq2_from_limbs(np.asarray(x)),
                                T.fq2_from_limbs(np.asarray(y))))
@@ -966,7 +935,6 @@ class JaxBackend:
         if k == 0:
             return gt.compress_g1(None)
         gx, gy = g1_to_limbs(gt.G1_GEN)
-        x, y, inf = _g1_scalar_mul(jnp.asarray(gx), jnp.asarray(gy),
-                                   jnp.asarray(_scalar_bits(k)))
+        x, y, inf = g1_scalar_mul(jnp.asarray(gx), jnp.asarray(gy), k)
         assert not bool(np.asarray(inf))
         return gt.compress_g1((F.from_mont(np.asarray(x)), F.from_mont(np.asarray(y))))

@@ -1,0 +1,72 @@
+"""Regression tests for bench.py's accelerator probe (BENCH_r04/r05: a
+wedged TPU relay hung the probe child in uninterruptible native code,
+subprocess.run's unbounded post-kill wait never returned, and `make bench`
+recorded rc=2 with no JSON instead of falling through to the CPU smoke
+shape)."""
+import os
+import time
+
+import pytest
+
+import bench
+
+
+def test_run_probe_child_kills_hung_child():
+    """A child that sleeps past the timeout is SIGKILLed (whole process
+    group) and reported as hung within a BOUNDED wait — not subprocess.run's
+    indefinite post-kill reap."""
+    t0 = time.monotonic()
+    rc, out, err = bench._run_probe_child(
+        "import time; time.sleep(600)", timeout_s=1)
+    elapsed = time.monotonic() - t0
+    assert rc is None
+    assert elapsed < 30, f"reap not bounded: {elapsed:.1f}s"
+
+
+def test_run_probe_child_passes_env_and_output():
+    rc, out, err = bench._run_probe_child(
+        "import os; print(os.environ.get('JAX_PLATFORMS', ''))",
+        timeout_s=60, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert rc == 0 and out.strip() == "cpu"
+
+
+def test_probe_hang_falls_through_to_cpu_smoke(monkeypatch):
+    """A simulated relay hang on the device probe must demote the run to
+    the CPU smoke shape (not exit 2): the CPU re-probe runs with
+    JAX_PLATFORMS=cpu pinned in the child ENV (a wedged relay can hang
+    `import jax` itself, so an in-code pin is too late), and the scale
+    knobs rebind so the artifact is still emitted."""
+    calls = []
+
+    def fake_child(code, timeout_s, env=None):
+        calls.append(env)
+        if env is None:                   # device probe: simulate the hang
+            return None, "", ""
+        assert env.get("JAX_PLATFORMS") == "cpu"
+        assert env.get("CSTPU_BENCH_CPU") == "1"
+        return 0, "cpu\n", ""
+
+    monkeypatch.setattr(bench, "_run_probe_child", fake_child)
+    monkeypatch.setattr(bench, "V_DEVICE", 1_000_000)
+    monkeypatch.setattr(bench, "V_STATE", 1_000_000)
+    monkeypatch.setattr(bench, "N_ATTESTATIONS", 128)
+    monkeypatch.setattr(bench, "_CPU_FALLBACK", False)
+    monkeypatch.setenv("CSTPU_BENCH_CPU", "")   # not the pinned-CPU mode
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")  # keep the parent pin (tests)
+    bench._probe_backend(timeout_s=1)
+    assert bench._CPU_FALLBACK is True
+    assert bench.V_DEVICE <= 65536 and bench.V_STATE <= bench.V_DEVICE
+    assert bench.N_ATTESTATIONS <= 32
+    assert len(calls) == 2 and calls[0] is None and calls[1] is not None
+
+
+def test_probe_cpu_unreachable_still_aborts(monkeypatch):
+    """Only a dead CPU backend (nothing to fall back to) may exit 2."""
+    def fake_child(code, timeout_s, env=None):
+        return None, "", ""               # everything hangs
+
+    monkeypatch.setattr(bench, "_run_probe_child", fake_child)
+    monkeypatch.setenv("CSTPU_BENCH_CPU", "")
+    with pytest.raises(SystemExit) as exc:
+        bench._probe_backend(timeout_s=1)
+    assert exc.value.code == 2

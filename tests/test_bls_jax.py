@@ -300,6 +300,36 @@ def test_hash_to_g2_batch_matches_oracle(backends):
     assert hash_to_g2_batch([]) == []
 
 
+def test_hash_batch_threshold_parity(backends):
+    """_HASH_BATCH_MIN switches per-message host bignum hashing to the
+    batched device cofactor multiply once the batch's DISTINCT (message,
+    domain) count reaches it; verdicts must agree with the oracle on both
+    sides of the threshold (the shortcut had no direct test). Items keep
+    the spec's 3-pair shape so only message count crosses the line."""
+    py, jx = backends
+    from consensus_specs_tpu.ops.bls_jax import _HASH_BATCH_MIN
+    assert _HASH_BATCH_MIN % 2 == 0   # 2 distinct messages per item
+    items = []
+    expected = []
+    for i in range(_HASH_BATCH_MIN // 2):
+        k0, k1 = 31 + 2 * i, 32 + 2 * i
+        msgs = [bytes([60 + 2 * i]) * 32, bytes([61 + 2 * i]) * 32]
+        agg = py.aggregate_signatures(
+            [py.sign(m, k, DOMAIN) for m, k in zip(msgs, (k0, k1))])
+        if i == 1:
+            msgs = msgs[::-1]   # one failing item for verdict variety
+        item = ([gt.privtopub(k0), gt.privtopub(k1)], msgs, agg, DOMAIN)
+        items.append(item)
+        expected.append(py.verify_multiple(*item))
+    assert expected[0] and not expected[1]
+    # the 4 staged items serve both sides: the 3-item prefix has 6 distinct
+    # (message, domain) keys -> host hashing; all 4 reach the threshold ->
+    # batched device cofactor multiply
+    for n_items in (len(items) - 1, len(items)):
+        assert jx.verify_multiple_batch(items[:n_items]) \
+            == expected[:n_items], n_items
+
+
 def test_grouped_miller_matches_pairwise_product():
     """The shared-squaring multi-pairing (miller_loop_grouped) must agree
     with the differential oracle: pairwise Miller loops multiplied
