@@ -49,11 +49,12 @@ lint:
 	$(PYTHON) tools/lint.py consensus_specs_tpu tests bench.py __graft_entry__.py tools
 
 # Trace-safety / spec-conformance static analysis (tools/analysis/README.md):
-# eight pass families over the call-graph IR — Python control flow on
+# nine pass families over the call-graph IR — Python control flow on
 # tracers, 32-bit truncation of uint64 math, impure traced code,
 # state-aliasing overrides, jit-cache hygiene, sharding/collective axis
-# consistency, pallas BlockSpec/grid/Ref contracts, and spec drift vs the
-# reference pyspec (REFERENCE_ROOT, skips with a notice when absent).
+# consistency, pallas BlockSpec/grid/Ref contracts, spec drift vs the
+# reference pyspec (REFERENCE_ROOT, skips with a notice when absent), and
+# wide-column accumulation past the double-width laziness budget (CSA901).
 # Exit 0 = no findings beyond the committed baseline + inline
 # `# csa: ignore[...]` suppressions. JSON artifact: out/analysis.json.
 REFERENCE_ROOT ?= /root/reference
@@ -91,7 +92,7 @@ smoke:
 	$(PYTHON) -m tools.analysis consensus_specs_tpu bench.py __graft_entry__.py \
 		--baseline tools/analysis/baseline.json \
 		--reference-root $(REFERENCE_ROOT)
-	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_bench_probe.py -q
+	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_bench_probe.py -q -m "not slow"
 
 clean:
 	rm -rf out .pytest_cache $(VECTOR_DIR)

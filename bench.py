@@ -269,6 +269,54 @@ def bench_scalar_mul_ab():
     return out
 
 
+def bench_pairing_redc_ab():
+    """A/B the tower reduction placement (CSTPU_FQ_REDC=leaf|coeff) on ONE
+    grouped_pairing_check at the spec shape (N_ATTESTATIONS groups x 3
+    pairs). Per backend: steady-state ms plus the REDC lane count of the
+    traced grouped-Miller + final-exp programs (ops/fq.py's trace-time
+    counters over FRESH traces — bls_jax's jitted pairing programs are
+    mode-keyed, so each backend really runs its own executable). Group
+    verdicts are asserted bit-identical across backends, and the >=2.5x
+    lane cut — the reason the coeff backend exists — is asserted, not
+    just recorded."""
+    import jax
+    import jax.numpy as jnp
+    from consensus_specs_tpu.ops import bls_jax as BJ
+    from consensus_specs_tpu.ops import fq as F
+
+    g1, g2 = _stage_attestation_pairs(N_ATTESTATIONS)
+    dg1, dg2 = jnp.asarray(g1), jnp.asarray(g2)
+    _sync((dg1, dg2))
+    f12 = jnp.zeros((N_ATTESTATIONS, 2, 3, 2, F.L), jnp.int64)
+    out = {"groups": int(N_ATTESTATIONS), "pairs_per_group": int(g1.shape[1])}
+    verdicts = {}
+    for name in ("leaf", "coeff"):
+        with F.pinned_fq_redc_backend(name):
+            # lane counts off fresh abstract traces (fresh lambdas: jax's
+            # trace cache keys on function identity and would otherwise
+            # serve the other mode's jaxpr)
+            F.reset_redc_trace_stats()
+            jax.make_jaxpr(lambda a, b: BJ.miller_loop_grouped(a, b))(dg1, dg2)
+            jax.make_jaxpr(lambda f: BJ.final_exponentiation_3x(f))(f12)
+            out[f"{name}_redc_lanes"] = F.redc_trace_stats()["lanes"]
+            verdicts[name] = np.asarray(
+                BJ.grouped_pairing_check(dg1, dg2))     # warm compile
+            iters = 3
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                # np.asarray materializes the [G] verdicts (honest fence)
+                np.asarray(BJ.grouped_pairing_check(dg1, dg2))
+            out[f"{name}_ms"] = round(
+                (time.perf_counter() - t0) / iters * 1e3, 2)
+    assert bool(verdicts["coeff"].all()), "staged signatures must verify"
+    assert np.array_equal(verdicts["leaf"], verdicts["coeff"]), \
+        "grouped-pairing verdicts differ between REDC backends"
+    ratio = out["leaf_redc_lanes"] / out["coeff_redc_lanes"]
+    out["redc_lane_ratio"] = round(ratio, 2)
+    assert ratio >= 2.5, f"REDC lane cut only {ratio:.2f}x"
+    return out
+
+
 def _stage_attestation_pairs(n_groups, n_distinct=8):
     """See ops/bls_jax.stage_example_groups (shared with the mesh tests and
     dryrun_multichip so all three present identical program shapes)."""
@@ -951,6 +999,12 @@ def main():
                   "adds vs %(cofactor_double_add_ms).1f ms / "
                   "%(cofactor_double_add_seq_adds)d adds; k256 "
                   "%(k256_window_ms).1f vs %(k256_double_add_ms).1f ms" % smab)
+    prab = _device("pairing REDC A/B", bench_pairing_redc_ab)
+    if prab is not None:
+        _progress("pairing REDC A/B: coeff %(coeff_ms).1f ms / "
+                  "%(coeff_redc_lanes)d lanes vs leaf %(leaf_ms).1f ms / "
+                  "%(leaf_redc_lanes)d lanes (%(redc_lane_ratio).1fx) @ "
+                  "%(groups)d groups" % prab)
     bls_res = _device("BLS batch", bench_bls_device)
     t_bls, t_py_verify = bls_res if bls_res is not None else (None, None)
     if t_bls is not None:
@@ -995,6 +1049,13 @@ def main():
                 smab["cofactor_double_add_ms"], smab["cofactor_window_ms"],
                 smab["k256_double_add_seq_adds"], smab["k256_window_seq_adds"],
                 smab["k256_double_add_ms"], smab["k256_window_ms"]))
+    if prab is not None:
+        parts.append(
+            "pairing REDC A/B: %d->%d lanes (%.1fx), coeff %.1f / leaf "
+            "%.1f ms @ %d groups" % (
+                prab["leaf_redc_lanes"], prab["coeff_redc_lanes"],
+                prab["redc_lane_ratio"], prab["coeff_ms"], prab["leaf_ms"],
+                prab["groups"]))
     if t_bls is not None:
         parts.append("%d-agg-verify %.1f ms = %.0f aggverify/s/chip" % (
             N_ATTESTATIONS, t_bls * 1e3, N_ATTESTATIONS / t_bls))
@@ -1031,6 +1092,8 @@ def main():
         record["merkle_backend_ab"] = ab
     if smab is not None:
         record["scalar_mul_ab"] = smab
+    if prab is not None:
+        record["pairing_redc_ab"] = prab
     print(json.dumps(record))
 
 

@@ -309,12 +309,37 @@ def miller_loop_grouped(g1_aff, g2_aff):
     return T.fq12_conj(f)  # negative BLS parameter
 
 
-_miller_loop_batch_jit = jax.jit(miller_loop_batch)
-_miller_loop_grouped_jit = jax.jit(miller_loop_grouped)
+def _redc_mode_jit(fn):
+    """One jitted program per CSTPU_FQ_REDC backend. The tower reads the
+    reduction placement at TRACE time (fq_tower._coeff), and jax's jit
+    cache keys on function identity + avals only — a runtime backend
+    switch would otherwise keep serving the other mode's executable
+    (correct values, wrong program: the lazy-REDC cut silently
+    disappears from an A/B measurement). Each mode gets its own wrapper
+    (fresh function identity => disjoint jit cache) that pins the mode
+    for the duration of tracing via F.pinned_fq_redc_backend, so the
+    program traced always matches the backend selected at call time."""
+    progs = {}
+
+    def call(*args):
+        mode = F.fq_redc_backend_name()
+        prog = progs.get(mode)
+        if prog is None:
+            def pinned(*a, _mode=mode):
+                with F.pinned_fq_redc_backend(_mode):
+                    return fn(*a)
+
+            progs[mode] = prog = jax.jit(pinned)
+        return prog(*args)
+
+    return call
 
 
-@jax.jit
-def _grouped_verdict_jit(f):
+_miller_loop_batch_jit = _redc_mode_jit(miller_loop_batch)
+_miller_loop_grouped_jit = _redc_mode_jit(miller_loop_grouped)
+
+
+def _grouped_verdict(f):
     """[G, 2, 3, 2, L] group-product Miller values -> [G] bool via ONE
     batched final exponentiation (the within-group product already
     accumulated in the Miller phase)."""
@@ -322,8 +347,10 @@ def _grouped_verdict_jit(f):
     return T.fq12_eq(res, T.fq12_ones((f.shape[0],)))
 
 
-@jax.jit
-def _group_product_is_one_jit(fs):
+_grouped_verdict_jit = _redc_mode_jit(_grouped_verdict)
+
+
+def _group_product_is_one(fs):
     """fs [G, P, 2, 3, 2, L] Miller values -> [G] bool: within-group
     product (short fori over P) + ONE final exponentiation batched over
     all G groups."""
@@ -335,6 +362,9 @@ def _group_product_is_one_jit(fs):
     f = jax.lax.fori_loop(0, P, body, T.fq12_ones((G,)))
     res = final_exponentiation_3x(f)
     return T.fq12_eq(res, T.fq12_ones((G,)))
+
+
+_group_product_is_one_jit = _redc_mode_jit(_group_product_is_one)
 
 
 def pairing_product_is_one(g1_batch, g2_batch):

@@ -1,30 +1,41 @@
 """Batched BLS12-381 base-field arithmetic in JAX: Montgomery form, lazy
-signed 29-bit limbs.
+signed 29-bit limbs, double-width lazy reduction.
 
 The reference delegates all field math to pure-Python bignums (py_ecc there,
 crypto/bls12_381.py here — /root/reference specs/bls_signature.md:96-146 for
 the contract). On TPU there is no wide multiplier, so an Fq element is a
-`[..., 14]` int64 array of 29-bit limbs (14x29 = 406 >= 381 bits).
+`[..., L]` int64 array of 29-bit limbs (14x29 = 406 >= 381 bits), and a
+double-width product is a `[..., 2L]` int64 array of schoolbook columns.
 
-Design (second iteration — the first used uint64 limbs with serial per-op
-carry chains, which made every add/sub a ~130-HLO graph and blew XLA
-compile time superlinearly once thousands of ops composed into a pairing):
+Design (third iteration — the first used uint64 limbs with serial per-op
+carry chains; the second reduced every bilinear leaf product in full even
+though the tower recombination that follows is linear):
 
 - **Lazy signed limbs.** add/sub/neg are single vector ops; limbs drift out
-  of [0, 2^29) and may go negative between multiplications. Only `fq_mul`
-  and the boundary ops re-normalize.
-- **Montgomery absorbs laziness.** `fq_mul` accepts any inputs whose limbs
-  fit ~2^32 and whose VALUES satisfy |v_a|*|v_b| < q*R (true for sums of up
-  to ~2^10 field-bounded terms); its output value is in (-2q, 2q). So
-  lazily-accumulated values flow straight into the next multiply with no
-  conditional subtracts anywhere.
+  of [0, 2^29) and may go negative between multiplications. Only the
+  multiply/reduce ops and the boundary ops re-normalize.
+- **Split multiply.** `fq_mul_wide` is the reduction-free schoolbook
+  (`[..., L] x [..., L] -> [..., 2L]` int64 columns); `fq_redc` is the
+  interleaved Montgomery reduction (`[..., 2L] -> [..., L]`, one
+  14-step dependent carry chain per lane). `fq_mul = fq_redc o
+  fq_mul_wide` — and the tower (ops/fq_tower.py) exploits the split:
+  because REDC is Z-linear, Karatsuba recombinations run on the WIDE
+  columns and reduce once per output coefficient instead of once per
+  leaf product (Aranha et al., EUROCRYPT 2011): fq12_mul 54 -> 12 REDC
+  lanes, the sparse line multiply 39 -> 12, squarings 36 -> 12, the
+  cyclotomic squaring 30 -> 12 (`CSTPU_FQ_REDC=coeff|leaf` selects;
+  `leaf` keeps per-leaf reduction as the differential oracle).
+- **Montgomery absorbs laziness.** `fq_mul`/`fq_mul_wide` accept any
+  inputs whose limbs fit ~2^32 and whose VALUES satisfy |v_a|*|v_b| < q*R
+  (true for sums of up to ~2^10 field-bounded terms); `fq_redc` output
+  value is in (-2q, 2q). So lazily-accumulated values flow straight into
+  the next multiply with no conditional subtracts anywhere.
 - **Vectorized carry rounds.** Normalization is rounds of
   (lo = v & MASK, hi = v >> B arithmetic, v = lo + shift_up(hi)) — whole-
-  vector ops. Three rounds crush magnitudes to limbs in [-1, 2^29]; exact
-  ripple (a borrow/carry travels one limb per round) needs L+3 rounds and
-  is reserved for the boundary ops (`fq_canon`, `fq_is_zero`, `fq_eq`),
-  where the unique signed-top representation makes sign and equality
-  testable.
+  vector ops, value-preserving, length-generic (the same `_carry_rounds`
+  serves L-limb elements and 2L-limb wide columns). Three rounds crush
+  magnitudes to limbs in [-1, 2^29]; exact ripple needs L+3 rounds and is
+  reserved for the boundary ops (`fq_canon`, `fq_is_zero`, `fq_eq`).
 - **No integer matmuls, ever.** The TPU v5e has no 64-bit integer dot
   unit: XLA's X64 rewriter emulates elementwise s64 mul/add/shift but
   rejects `s64 dot_general`. The schoolbook is therefore L statically
@@ -33,19 +44,35 @@ compile time superlinearly once thousands of ops composed into a pairing):
   stack (fq_tower's bilinear tables) is unrolled the same way.
 
 Every function is elementwise over leading batch axes; stacking independent
-multiplications along a batch axis (see fq_tower's bilinear fq12 product)
-is the intended usage pattern — it keeps both the traced graph and the
-device dispatch count flat: the graph is the same size for a batch of 2 and
-a batch of 10^6.
+lanes along a batch axis (see fq_tower's bilinear fq12 product) is the
+intended usage pattern — the traced graph is the same size for a batch of 2
+and a batch of 10^6.
 
 Laziness budget (enforced by usage convention, asserted in tests):
-inputs to fq_mul must be sums/differences of at most ~2^10 Montgomery
-outputs (values < 2^10 * 2q < 2^393, limbs < 2^33 lazily or [-1, 2^29]
-after fq_norm). Tower code keeps well under this (<= 32 terms).
+
+- *Narrow domain* (`[..., L]`, inputs to fq_mul/fq_mul_wide): limbs
+  |l| < ~2^32 (three defensive carry rounds bring them to [-1, 2^29]),
+  values sums/differences of at most ~2^10 Montgomery outputs
+  (|v| < 2^10 * 2q < 2^393, keeping |v_a|*|v_b| < q*R = 2^787). Tower
+  pre-sums keep well under this (<= 8 terms).
+- *Wide domain* (`[..., 2L]` columns): a single `fq_mul_wide` of
+  normalized operands yields |col| <= 14*2^58 < 2^62 — NO headroom for
+  accumulation (three raw products already overflow int64). Any >2-term
+  wide accumulation must interpose `fq_wide_norm` (value-preserving wide
+  carry rounds, |col| back to [-1, 2^29]) first; the static analyzer
+  flags violations (CSA901). `fq_redc` accepts |col| < 2^35 — the
+  64-abs-fan-in gamma ceiling fq_tower's `_check_budget` enforces times
+  2^29 — and its output window is (v/R - q, v/R + q), i.e. (-2q, 2q)
+  whenever |value| < q*R; iterated additive passthroughs must enter the
+  wide domain through a reduction-free multiply by one (value <= |a|*q,
+  keeps the window contracting — fq_tower.fq12_cyclo_sqr), not the
+  shift-lift `fq_wide_from_mont` (value |a|*R, window grows per step).
 """
 from __future__ import annotations
 
-from typing import Sequence
+import contextlib
+import os
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -121,13 +148,77 @@ def stack_mont(values: Sequence[int]) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Backend knob: where the tower reduces (mirrors CSTPU_SCALAR_MUL)
+# ---------------------------------------------------------------------------
+
+_REDC_BACKENDS = ("coeff", "leaf")
+_redc_override: Optional[str] = None
+
+
+def set_fq_redc_backend(name: Optional[str]) -> None:
+    """Pin the tower reduction placement ("coeff" = one REDC per output
+    coefficient over wide columns, "leaf" = one REDC per bilinear leaf
+    product — the differential oracle); None returns control to the
+    CSTPU_FQ_REDC environment variable (default "coeff")."""
+    global _redc_override
+    assert name is None or name in _REDC_BACKENDS, name
+    _redc_override = name
+
+
+def fq_redc_backend_name() -> str:
+    name = _redc_override or os.environ.get("CSTPU_FQ_REDC", "coeff")
+    if name not in _REDC_BACKENDS:
+        raise ValueError(
+            f"CSTPU_FQ_REDC must be one of {_REDC_BACKENDS}, got {name!r}")
+    return name
+
+
+@contextlib.contextmanager
+def pinned_fq_redc_backend(name: str):
+    """Pin the backend for a scope — ops/bls_jax.py wraps every call into
+    its mode-keyed jitted pairing programs with this, so the mode read at
+    TRACE time always matches the program being traced."""
+    # trace-time-once is the POINT here: the write pins the backend for
+    # the duration of tracing (bls_jax._redc_mode_jit keys one program
+    # per mode); nothing reads the global at run time.
+    # csa: ignore[CSA302]
+    global _redc_override
+    assert name in _REDC_BACKENDS, name
+    prev = _redc_override
+    _redc_override = name
+    try:
+        yield
+    finally:
+        _redc_override = prev
+
+
+# Trace-time REDC accounting: every fq_redc call (fq_mul included) adds its
+# static lane count — prod(batch shape) of the stacked reduction — so
+# tracing a program with the counters reset yields its traced-graph REDC
+# instance/lane totals (loop bodies count once). bench.py's pairing_redc_ab
+# row and tests/test_fq_redc.py's jaxpr cross-check read these.
+_REDC_TRACE = {"instances": 0, "lanes": 0}
+
+
+def reset_redc_trace_stats() -> None:
+    _REDC_TRACE["instances"] = 0
+    _REDC_TRACE["lanes"] = 0
+
+
+def redc_trace_stats() -> dict:
+    return dict(_REDC_TRACE)
+
+
+# ---------------------------------------------------------------------------
 # Normalization (device)
 # ---------------------------------------------------------------------------
 
 def _carry_rounds(t, n: int):
     """n rounds of vectorized carry/borrow propagation (value-preserving:
     the top limb keeps its own overflow in place, so values up to int64
-    range at the top limb survive; callers keep |value| < ~2^395)."""
+    range at the top limb survive; callers keep |value| < ~2^395 narrow /
+    < q*R wide). Length-generic: works on [..., L] elements and
+    [..., 2L] wide columns alike."""
     for _ in range(n):
         lo = t & MASK
         hi = t >> B          # arithmetic shift: borrows propagate as -1
@@ -144,6 +235,18 @@ def fq_norm(a, rounds: int = 3):
     [-1, 2^29] (a stable lazy form — products still fit int64 columns).
     Use NORM_FULL rounds for the unique signed-top representation."""
     return _carry_rounds(a, rounds)
+
+
+def fq_wide_norm(t, rounds: int = 3):
+    """Value-preserving carry rounds over [..., 2L] wide columns: 3 rounds
+    crush raw schoolbook columns (|col| <= 14*2^58 < 2^62) into
+    [-1, 2^29] — except the TOP column, which keeps the value spill in
+    place (|top| ~ value >> 29*27, a handful for in-budget values) —
+    restoring the headroom that >2-term wide accumulation (the tower's
+    gamma combinations, fan-in up to 36) needs: the interposed round the
+    laziness budget (module docstring) and the CSA901 analyzer rule
+    require."""
+    return _carry_rounds(jnp.asarray(t), rounds)
 
 
 # ---------------------------------------------------------------------------
@@ -189,32 +292,69 @@ for _i in range(L):
     _Q_SHIFTS[_i, _i + 1:_i + L] = _Q_NP[1:]
 
 
-def fq_mul(a, b):
-    """Montgomery product a*b*R^-1 mod q — LAZY in and out.
+def fq_mul_wide(a, b):
+    """Schoolbook double-width product — NO reduction. [..., L] x [..., L]
+    -> [..., 2L] int64 columns with cols[k] = sum_{i+j=k} a_i b_j.
 
     Inputs: limbs |l| < ~2^32 (three defensive carry rounds bring them to
-    [-1, 2^29]), values |v_a|*|v_b| < q*R (see module docstring). Output:
-    limbs in [-1, 2^29], value in (-2q, 2q). No conditional subtracts.
+    [-1, 2^29]), values per the narrow laziness budget. Output columns
+    reach 14*2^58 < 2^62 — NOT accumulable more than two deep without an
+    interposed fq_wide_norm (see the module docstring's wide budget).
 
-    TPU-legal by construction: the v5e has no 64-bit integer dot unit (the
-    X64 rewriter implements elementwise s64 mul/add/shift but rejects
-    `s64 dot_general`), so the schoolbook is L unrolled shifted adds of
-    elementwise products — never a matmul. The 14-step interleaved
-    reduction is unrolled at ~8 ops per step. Batch leading axes
-    aggressively."""
+    TPU-legal by construction: the v5e has no 64-bit integer dot unit, so
+    the schoolbook is L unrolled shifted adds of elementwise products —
+    never a matmul."""
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)
     a = _carry_rounds(a, 3)
     b = _carry_rounds(b, 3)
-    # schoolbook: cols[k] = sum_{i+j=k} a_i b_j  (|col| <= 14*2^58 < 2^63)
-    # as L statically-placed shifted adds of [..., L] elementwise products
     pad = [(0, 0)] * (len(shape) - 1)
-    cols = sum(
+    return sum(
         jnp.pad(a[..., i:i + 1] * b, pad + [(i, L - i)]) for i in range(L))
-    # interleaved Montgomery reduction (m and the carry are sign-correct:
-    # & MASK works on two's complement, >> is arithmetic = exact floor
-    # division since v + m*q0 is divisible by 2^B)
+
+
+def fq_wide_from_mont(a):
+    """Montgomery element [..., L] -> wide columns [..., 2L] carrying the
+    value a*R (limbs shifted up L columns after a defensive
+    normalization), so `fq_redc` maps it back to a mod q.
+
+    Value-window caveat: the lift is mod-q exact but NOT contracting —
+    the wide value is |a|*R, so mixing it into a REDC input pushes the
+    output window out by |a| (fq_redc returns values in (v/R - q, v/R +
+    q)). One-shot additive mixes are fine; ITERATED passthroughs (the
+    cyclotomic squaring chain) must instead enter as a reduction-free
+    wide multiply by one (value |a|*(R mod q) <= |a|*q — see
+    fq_tower.fq12_cyclo_sqr), or the window doubles per step and escapes
+    |v| < q*R after ~25 squarings."""
+    a = _carry_rounds(jnp.asarray(a), 3)
+    return jnp.concatenate([jnp.zeros_like(a), a], axis=-1)
+
+
+def fq_redc(cols):
+    """Interleaved Montgomery reduction: [..., 2L] wide columns of value v
+    -> [..., L] limbs of value v * R^-1 mod q — LAZY out.
+
+    Input bound (the laziness budget, asserted against exact host bignums
+    in tests/test_fq_redc.py): limbs |col| < 2^35 (the 64-abs-fan-in
+    gamma ceiling x 2^29 — raw fq_mul_wide columns at 14*2^58 < 2^62 are
+    fine too, but only ONE deep; >2-term accumulations must interpose
+    fq_wide_norm first) and |value| < q*R. Output: limbs in [-1, 2^29],
+    value in (-2q, 2q). No conditional subtracts.
+
+    The 14-step reduction is unrolled at ~8 ops per step; m and the carry
+    are sign-correct (& MASK works on two's complement, >> is arithmetic
+    = exact floor division since v + m*q0 is divisible by 2^B). Batch
+    leading axes aggressively — the per-lane cost is why the tower
+    reduces per output coefficient, not per leaf."""
+    cols = jnp.asarray(cols)
+    shape = cols.shape
+    assert shape[-1] == 2 * L, shape
+    lanes = 1
+    for d in shape[:-1]:
+        lanes *= int(d)
+    _REDC_TRACE["instances"] += 1
+    _REDC_TRACE["lanes"] += lanes
     carry = jnp.zeros(shape[:-1], dtype=jnp.int64)
     qinv = jnp.int64(QINV_NEG)
     mask = jnp.int64(MASK)
@@ -226,6 +366,13 @@ def fq_mul(a, b):
         cols = cols + m[..., None] * jnp.asarray(_Q_SHIFTS[i])
     upper = cols[..., L:].at[..., 0].add(carry)
     return _carry_rounds(upper, 3)
+
+
+def fq_mul(a, b):
+    """Montgomery product a*b*R^-1 mod q — LAZY in and out: exactly
+    fq_redc(fq_mul_wide(a, b)). See those for the bounds; output limbs in
+    [-1, 2^29], value in (-2q, 2q)."""
+    return fq_redc(fq_mul_wide(a, b))
 
 
 def fq_sqr(a):
@@ -280,9 +427,73 @@ def _exp_bits(e: int) -> np.ndarray:
 _INV_EXP_BITS = _exp_bits(Q - 2)
 _SQRT_EXP_BITS = _exp_bits((Q + 1) // 4)
 
+# Fixed-window width for the static exponents (q-2, (q+1)/4): the
+# multiply-count sweet spot (2^w - 2 table muls + ceil(nbits/w) walk muls;
+# w=4 at 381 bits: 109 vs 381 per-bit select-muls, a 3.5x cut — w=5's
+# bigger table already costs more than the walk saves).
+_POW_WINDOW = 4
 
-def _fq_pow_static(a, bits_np: np.ndarray):
-    """a^e with e given as a static bit array; fori over bits, select-mul."""
+
+def _exp_window_digits(bits_np: np.ndarray, w: int) -> np.ndarray:
+    """Host: MSB-first bit array -> [ceil(n/w)] int32 w-bit window digits
+    (MSB-window first, zero-padded at the top) — the exponent-level
+    analogue of ops/scalar_mul's host recoding: static data, never
+    traced."""
+    n = int(bits_np.shape[0])
+    m = -(-n // w)
+    padded = np.concatenate(
+        [np.zeros(m * w - n, np.uint8), bits_np.astype(np.uint8)])
+    weights = 1 << np.arange(w - 1, -1, -1, dtype=np.int64)
+    return (padded.reshape(m, w) @ weights).astype(np.int32)
+
+
+def pow_static_muls(nbits: int, w: int) -> int:
+    """Analytic multiply count of the windowed walk (squarings excluded —
+    both paths square once per bit): table build + one gathered multiply
+    per window. The per-bit oracle pays `nbits` select-muls."""
+    return ((1 << w) - 2) + (-(-nbits // w) - 1)
+
+
+def _fq_pow_static(a, bits_np: np.ndarray, w: Optional[int] = None):
+    """a^e with e a static bit array — fixed-window evaluation.
+
+    Device: a power table [a^0 .. a^(2^w - 1)] built by one fori chain
+    (scattered into a stacked table axis, so the traced graph holds ONE
+    fq_mul instance), then ceil(nbits/w) trips of (w squarings + ONE
+    gathered multiply). Zero digits multiply by table[0] = one — regular
+    structure, no select. Digits are host-recoded static int32s
+    (_exp_window_digits); the per-bit form (_fq_pow_static_per_bit) stays
+    as the differential oracle in tests."""
+    if w is None:
+        w = _POW_WINDOW
+    digits_np = _exp_window_digits(bits_np, w)
+    m = int(digits_np.shape[0])
+    a = fq_norm(a)
+    n_tab = 1 << w
+    ones = fq_ones(a.shape[:-1])
+    table = jnp.broadcast_to(ones[None], (n_tab,) + ones.shape)
+    table = table.at[1].set(a)
+
+    def tab_body(j, tab):
+        return tab.at[j].set(fq_mul(jnp.take(tab, j - 1, axis=0), a))
+
+    if n_tab > 2:
+        table = jax.lax.fori_loop(2, n_tab, tab_body, table)
+    digits = jnp.asarray(digits_np)
+
+    def body(i, acc):
+        acc = jax.lax.fori_loop(0, w, lambda j, x: fq_mul(x, x), acc)
+        return fq_mul(acc, jnp.take(table, digits[i], axis=0))
+
+    acc = jnp.take(table, digits[0], axis=0)
+    if m > 1:
+        acc = jax.lax.fori_loop(1, m, body, acc)
+    return acc
+
+
+def _fq_pow_static_per_bit(a, bits_np: np.ndarray):
+    """a^e, one square + select-mul per bit — the windowed walk's
+    differential oracle (tests/test_fq_redc.py)."""
     bits = jnp.asarray(bits_np.astype(np.uint8))
     n = int(bits_np.shape[0])
     a = fq_norm(a)

@@ -12,15 +12,32 @@ plus Frobenius maps f -> f^(q^k) via host-precomputed coefficient tables
 (basis element v^i w^j = w^(2i+j) picks up xi^((q^k-1)(2i+j)/6)).
 
 Compile-time/dispatch discipline: a multiplication at any tower level costs
-exactly ONE `fq_mul` instance. fq2_mul stacks its 3 Karatsuba leaves on a
-new axis; fq12_mul is a bilinear algorithm — its 54 Fq leaf products are
-one [..., 54, L] fq_mul between coefficient tables applied as trace-time
-unrolled adds (`_apply_int_matrix` — NEVER an einsum/dot_general: s64
-matmuls don't lower to the TPU; alpha/beta are small-integer pre-sum
-matrices (entries in {-2..2}: mul_xi/squaring pre-sums subtract and can
-fold a component twice),
-gamma the signed post-combination matrix), all derived at import time by
-running the tower's Karatsuba structure symbolically. Additions/subtractions are lazy single ops.
+exactly ONE stacked multiply instance. fq2_mul stacks its 3 Karatsuba
+leaves on a new axis; fq12_mul is a bilinear algorithm — its 54 Fq leaf
+products are one [..., 54, L] stacked multiply between coefficient tables
+applied as trace-time unrolled adds (`_apply_int_matrix` — NEVER an
+einsum/dot_general: s64 matmuls don't lower to the TPU; alpha/beta are
+small-integer pre-sum matrices (entries in {-2..2}: mul_xi/squaring
+pre-sums subtract and can fold a component twice), gamma the signed
+post-combination matrix), all derived at import time by running the
+tower's Karatsuba structure symbolically. Additions/subtractions are lazy
+single ops.
+
+Reduction placement (CSTPU_FQ_REDC, ops/fq.py): under the default `coeff`
+backend the leaf products stay DOUBLE-WIDTH (`fq_mul_wide` columns,
+crushed by one value-preserving `fq_wide_norm` before any accumulation)
+and the gamma recombination runs in the wide domain — Montgomery
+reduction is Z-linear, so ONE `fq_redc` per output coefficient replaces
+one per leaf (Aranha et al., EUROCRYPT 2011): fq2_mul 3 -> 2 REDC lanes,
+fq12_mul 54 -> 12, fq12_sqr 36 -> 12, fq12_mul_line 39 -> 12,
+fq12_cyclo_sqr 30 -> 12 (its +-2*conj passthrough rides the output REDC
+via a reduction-free wide multiply by one — NOT `fq_wide_from_mont`,
+whose non-contracting |a|*R value window is unsafe for iterated
+passthroughs — instead of paying its own normalization multiply).
+`leaf` keeps the per-leaf `fq_mul` path as the differential
+oracle; both backends are value-identical (tests/test_fq_redc.py pins
+them against each other and the bignum tower, and counts the REDC lanes
+in the traced jaxprs).
 """
 from __future__ import annotations
 
@@ -89,8 +106,33 @@ def fq2_conj(a):
     return jnp.concatenate([a[..., 0:1, :], -a[..., 1:2, :]], axis=-2)
 
 
+def _coeff():
+    """True when the tower reduces once per output coefficient (the
+    CSTPU_FQ_REDC=coeff default), read at trace time — ops/bls_jax.py keys
+    its jitted pairing programs on this so a backend switch retraces."""
+    return F.fq_redc_backend_name() == "coeff"
+
+
+def _fq2_mul_wide(a, b):
+    """Karatsuba recombination of (a0 + a1 u)(b0 + b1 u) in the WIDE
+    domain: 3 double-width leaf products, one interposed fq_wide_norm
+    (raw columns reach 14*2^58 — the 3-term c1 sum needs the headroom),
+    NO reduction. Returns [..., 2, 2L] columns with limbs <= 3*2^29."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    A = jnp.stack([a0, a1, a0 + a1], axis=-2)
+    Bv = jnp.stack([b0, b1, b0 + b1], axis=-2)
+    Pw = F.fq_wide_norm(F.fq_mul_wide(A, Bv))
+    t0, t1, t2 = Pw[..., 0, :], Pw[..., 1, :], Pw[..., 2, :]
+    return jnp.stack([t0 - t1, t2 - t0 - t1], axis=-2)
+
+
 def fq2_mul(a, b):
-    """(a0 + a1 u)(b0 + b1 u) — Karatsuba, ONE stacked fq_mul of 3 leaves."""
+    """(a0 + a1 u)(b0 + b1 u) — Karatsuba, ONE stacked multiply of 3
+    leaves; coeff backend reduces the 2 recombined output coefficients
+    instead of the 3 leaves."""
+    if _coeff():
+        return F.fq_redc(_fq2_mul_wide(a, b))
     a0, a1 = a[..., 0, :], a[..., 1, :]
     b0, b1 = b[..., 0, :], b[..., 1, :]
     A = jnp.stack([a0, a1, a0 + a1], axis=-2)
@@ -326,14 +368,19 @@ def _derive_fq12_line_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
 
 
 def _check_budget(alpha, beta, gamma, name: str):
-    # laziness check: pre-sum fan-in and post-combination growth must fit
-    # fq_mul's budget — limbs <= 64*2^29 = 2^35 (crushed by its defensive
-    # carry rounds) and values <= 64*2q < 2^388, keeping
-    # |v_a|*|v_b| < q*R = 2^787. A real raise: python -O must not strip it.
+    # laziness check, BOTH backends: pre-sum fan-in and post-combination
+    # growth must fit the budgets in ops/fq.py. Narrow (leaf): limbs
+    # <= 64*2^29 = 2^35 (crushed by fq_mul's defensive carry rounds),
+    # values <= 64*2q < 2^388, keeping |v_a|*|v_b| < q*R = 2^787. Wide
+    # (coeff): gamma rows sum wide-NORMALIZED columns (|col| <= 2^29 after
+    # the interposed fq_wide_norm), so a 64 fan-in keeps |col| < 2^35 =
+    # fq_redc's documented input bound, and values <= 64*(8*2q)^2 < 2^776
+    # < q*R (actual rows stay <= 36). A real raise: python -O must not
+    # strip it.
     if (int(np.abs(gamma).sum(axis=1).max()) > 64
             or int(np.abs(alpha).sum(axis=1).max()) > 8
             or int(np.abs(beta).sum(axis=1).max()) > 8):
-        raise ValueError(f"{name} tables exceed the fq_mul laziness budget")
+        raise ValueError(f"{name} tables exceed the fq laziness budget")
 
 
 _ALPHA, _BETA, _GAMMA = _derive_fq12_tables()
@@ -432,11 +479,13 @@ def fq12_add(a, b):
 
 
 def _apply_int_matrix(mat: np.ndarray, x):
-    """[R, C] small-int static matrix applied over x's C axis ([..., C, L])
-    as trace-time-unrolled adds — NEVER a dot_general (the TPU X64 rewriter
-    has no s64 matmul). mat entries are tiny (fan-in <= 64 by the laziness
-    budget check below), so each output row is a short sum of +/-x[c] terms
-    with an occasional small scalar multiple (elementwise s64: TPU-legal)."""
+    """[R, C] small-int static matrix applied over x's C axis ([..., C, K],
+    K = L narrow limbs or 2L wide columns) as trace-time-unrolled adds —
+    NEVER a dot_general (the TPU X64 rewriter has no s64 matmul). mat
+    entries are tiny (fan-in <= 64 by the laziness budget check below), so
+    each output row is a short sum of +/-x[c] terms with an occasional
+    small scalar multiple (elementwise s64: TPU-legal). Wide callers MUST
+    hand in fq_wide_norm'd columns (the CSA901 contract)."""
     rows = []
     for r in range(mat.shape[0]):
         acc = None
@@ -451,31 +500,42 @@ def _apply_int_matrix(mat: np.ndarray, x):
                 term = term * jnp.int64(v)
             acc = term if acc is None else acc + term
         if acc is None:
-            acc = jnp.zeros(x.shape[:-2] + (F.L,), dtype=jnp.int64)
+            acc = jnp.zeros(x.shape[:-2] + (x.shape[-1],), dtype=jnp.int64)
         rows.append(acc)
     return jnp.stack(rows, axis=-2)
 
 
+def _bilinear(alpha, beta, gamma, av, bv):
+    """The shared bilinear core: pre-sums, stacked leaf products, gamma
+    recombination. coeff: leaves stay wide (one interposed fq_wide_norm
+    restores accumulation headroom), gamma runs over the wide columns,
+    and ONE fq_redc reduces the 12 output coefficients. leaf: one fq_mul
+    reduces every leaf, gamma runs narrow (the differential oracle)."""
+    A = _apply_int_matrix(alpha, av)
+    Bv = _apply_int_matrix(beta, bv)
+    if _coeff():
+        Pw = F.fq_wide_norm(F.fq_mul_wide(A, Bv))         # [..., N, 2L]
+        return F.fq_redc(_apply_int_matrix(gamma, Pw))    # [..., 12, L]
+    P = F.fq_mul(A, Bv)                                   # [..., N, L]
+    return _apply_int_matrix(gamma, P)
+
+
 def fq12_mul(a, b):
-    """Bilinear bundle: all 54 Fq leaf products in ONE fq_mul call."""
+    """Bilinear bundle: all 54 Fq leaf products in ONE stacked multiply
+    (coeff: 12 REDC lanes; leaf: 54)."""
     batch = a.shape[:-4]
     av = a.reshape(batch + (12, F.L))
     bv = b.reshape(batch + (12, F.L))
-    A = _apply_int_matrix(_ALPHA, av)
-    Bv = _apply_int_matrix(_BETA, bv)
-    P = F.fq_mul(A, Bv)                                   # [..., 54, L]
-    cv = _apply_int_matrix(_GAMMA, P)
+    cv = _bilinear(_ALPHA, _BETA, _GAMMA, av, bv)
     return cv.reshape(batch + (2, 3, 2, F.L))
 
 
 def fq12_sqr(a):
-    """Complex-method squaring: ONE fq_mul of 36 leaves (vs 54 for mul)."""
+    """Complex-method squaring: ONE stacked multiply of 36 leaves (vs 54
+    for mul; coeff: 12 REDC lanes)."""
     batch = a.shape[:-4]
     av = a.reshape(batch + (12, F.L))
-    A = _apply_int_matrix(_SQR_ALPHA, av)
-    Bv = _apply_int_matrix(_SQR_BETA, av)
-    P = F.fq_mul(A, Bv)                                   # [..., 36, L]
-    cv = _apply_int_matrix(_SQR_GAMMA, P)
+    cv = _bilinear(_SQR_ALPHA, _SQR_BETA, _SQR_GAMMA, av, av)
     return cv.reshape(batch + (2, 3, 2, F.L))
 
 
@@ -483,22 +543,20 @@ def fq12_mul_line(f, c_a, c_v, c_vw):
     """f * (c_a + c_v*v + c_vw*(v*w)) — the Miller-loop line multiply.
 
     The line's six structurally-zero components are dropped at
-    table-derivation time: ONE fq_mul of 39 leaves (vs 54 for assembling
-    the line into a full fq12 element first). c_* are Fq2 [..., 2, L]."""
+    table-derivation time: ONE stacked multiply of 39 leaves (vs 54 for
+    assembling the line into a full fq12 element first; coeff: 12 REDC
+    lanes). c_* are Fq2 [..., 2, L]."""
     batch = f.shape[:-4]
     fv = f.reshape(batch + (12, F.L))
     bv = jnp.concatenate([c_a, c_v, c_vw], axis=-2)       # [..., 6, L]
-    A = _apply_int_matrix(_LINE_ALPHA, fv)
-    Bv = _apply_int_matrix(_LINE_BETA, bv)
-    P = F.fq_mul(A, Bv)                                   # [..., 39, L]
-    cv = _apply_int_matrix(_LINE_GAMMA, P)
+    cv = _bilinear(_LINE_ALPHA, _LINE_BETA, _LINE_GAMMA, fv, bv)
     return cv.reshape(batch + (2, 3, 2, F.L))
 
 
 def fq12_cyclo_sqr(a):
     """Granger–Scott squaring in the cyclotomic subgroup G_Φ6(q^2):
-    30 leaf products across two fq_mul calls (vs 54 general / 36
-    complex-method).
+    30 REDC lanes across two stacked multiplies under the leaf backend
+    (vs 54 general / 36 complex-method), 12 under coeff.
 
     View Fq12 = Fq4[y]/(y^3 - s), Fq4 = Fq2[s]/(s^2 - ξ) with y = w,
     s = w^3; component z_e (coefficient of w^e) is stored at
@@ -515,19 +573,32 @@ def fq12_cyclo_sqr(a):
     The ±2·conj terms pass input components straight to the output with no
     intervening Montgomery reduction, so chained squarings (runs of up to
     47 between the sparse BLS parameter's set bits) would grow VALUES ~2x
-    per step past fq_mul's |v_a|*|v_b| < q*R budget. One stacked
-    multiply-by-one Montgomery-reduces all twelve Fq components first
-    (value back into (-2q, 2q), limbs normalized): 12 extra leaves, 30
-    total."""
-    zs = F.fq_mul(a.reshape(a.shape[:-4] + (12, F.L)),
-                  F.fq_ones(())).reshape(a.shape)
-    z = [zs[..., e % 2, e // 2, :, :] for e in range(6)]
+    per step past the |v_a|*|v_b| < q*R budget. Under the `leaf` backend
+    one stacked multiply-by-one Montgomery-reduces all twelve Fq
+    components first (value back into (-2q, 2q), limbs normalized): 12
+    extra leaves, 30 total. Under `coeff` the passthrough instead rides
+    the OUTPUT reduction: components enter the wide accumulation as
+    reduction-free wide products with one (value z*(R mod q) <= 2q*q —
+    NOT the shift-lift z*R, whose 2x-per-step value growth would escape
+    REDC's contraction window |v| < q*R by step ~26), so the single
+    12-lane fq_redc both reduces the squaring and re-reduces the
+    passthrough into (-2q, 2q): chaining is safe with no reduction lanes
+    spent on normalization (the 50-step chain regression runs on both
+    backends in tests)."""
+    coeff = _coeff()
+    if coeff:
+        z_src = F.fq_norm(a)
+    else:
+        z_src = F.fq_mul(a.reshape(a.shape[:-4] + (12, F.L)),
+                         F.fq_ones(())).reshape(a.shape)
+    z = [z_src[..., e % 2, e // 2, :, :] for e in range(6)]
     pairs = [(z[0], z[3]), (z[1], z[4]), (z[2], z[5])]    # A, B, C
     lhs = jnp.stack([x0 + x1 for x0, x1 in pairs]
                     + [x0 for x0, _ in pairs], axis=-3)
     rhs = jnp.stack([x0 + fq2_mul_xi(x1) for x0, x1 in pairs]
                     + [x1 for _, x1 in pairs], axis=-3)
-    P = fq2_mul(lhs, rhs)                                 # [..., 6, 2, L]
+    # [..., 6, 2, L] narrow / [..., 6, 2, 2L] wide-normalized
+    P = _fq2_mul_wide(lhs, rhs) if coeff else fq2_mul(lhs, rhs)
     sq = []                                               # A², B², C² in Fq4
     for k in range(3):
         m1, m2 = P[..., k, :, :], P[..., 3 + k, :, :]
@@ -540,13 +611,25 @@ def fq12_cyclo_sqr(a):
     def x2(t):
         return t + t
 
+    # coeff: the conjugate passthrough enters the wide accumulation as a
+    # reduction-free multiply by one — ONE batched fq_mul_wide over all
+    # twelve components, wide-normalized so the 3X +- 2z sums stay under
+    # the 2^35 budget (3*12*2^29 from the squares + 2*2^29 passthrough)
+    if coeff:
+        zw_src = F.fq_wide_norm(F.fq_mul_wide(z_src, F.fq_ones(())))
+        zw = [zw_src[..., e % 2, e // 2, :, :] for e in range(6)]
+    else:
+        zw = z
     out = [None] * 6
-    out[0] = x3(A2[0]) - x2(z[0])                         # A' = 3A² - 2Ā
-    out[3] = x3(A2[1]) + x2(z[3])
-    out[1] = x3(fq2_mul_xi(C2[1])) + x2(z[1])             # B' = 3sC² + 2B̄
-    out[4] = x3(C2[0]) - x2(z[4])
-    out[2] = x3(B2[0]) - x2(z[2])                         # C' = 3B² - 2C̄
-    out[5] = x3(B2[1]) + x2(z[5])
+    out[0] = x3(A2[0]) - x2(zw[0])                        # A' = 3A² - 2Ā
+    out[3] = x3(A2[1]) + x2(zw[3])
+    out[1] = x3(fq2_mul_xi(C2[1])) + x2(zw[1])            # B' = 3sC² + 2B̄
+    out[4] = x3(C2[0]) - x2(zw[4])
+    out[2] = x3(B2[0]) - x2(zw[2])                        # C' = 3B² - 2C̄
+    out[5] = x3(B2[1]) + x2(zw[5])
+    if coeff:
+        red = F.fq_redc(jnp.stack(out, axis=-3))          # [..., 6, 2, L]
+        out = [red[..., e, :, :] for e in range(6)]
     rows = [jnp.stack([out[2 * i + j] for i in range(3)], axis=-3)
             for j in range(2)]
     return jnp.stack(rows, axis=-4)

@@ -631,6 +631,80 @@ def test_pallas_suppression(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# CSA901 wide-column accumulation (the double-width lazy-Montgomery budget)
+# ---------------------------------------------------------------------------
+
+def test_wide_accumulation_flags_three_term_sum(tmp_path):
+    src = (
+        "from consensus_specs_tpu.ops import fq as F\n"
+        "def f(a, b, c):\n"
+        "    t0 = F.fq_mul_wide(a, b)\n"
+        "    t1 = F.fq_mul_wide(a, c)\n"
+        "    t2 = F.fq_mul_wide(b, c)\n"
+        "    return t0 + t1 - t2\n"
+    )
+    found = findings_for(tmp_path, src)
+    assert rule_ids(found) == ["CSA901"]
+    assert found[0].severity == "notice"
+
+
+def test_wide_accumulation_flags_augassign_loop(tmp_path):
+    # taint accumulates through rebinding and +=
+    src = (
+        "from consensus_specs_tpu.ops import fq as F\n"
+        "def f(a, bs):\n"
+        "    acc = F.fq_mul_wide(a, bs[0])\n"
+        "    acc += F.fq_mul_wide(a, bs[1])\n"
+        "    acc += F.fq_mul_wide(a, bs[2])\n"
+        "    return acc\n"
+    )
+    assert rule_ids(findings_for(tmp_path, src)) == ["CSA901"]
+
+
+def test_wide_accumulation_flags_matrix_over_raw_columns(tmp_path):
+    src = (
+        "from consensus_specs_tpu.ops import fq as F\n"
+        "from consensus_specs_tpu.ops.fq_tower import _apply_int_matrix\n"
+        "def f(gamma, a, b):\n"
+        "    P = F.fq_mul_wide(a, b)\n"
+        "    return _apply_int_matrix(gamma, P)\n"
+    )
+    assert rule_ids(findings_for(tmp_path, src)) == ["CSA901"]
+
+
+def test_wide_accumulation_negative_normed_and_shallow(tmp_path):
+    # the shipped pipeline shape: fq_wide_norm clears the taint, and a
+    # 2-term raw sum is inside the int64 headroom
+    src = (
+        "from consensus_specs_tpu.ops import fq as F\n"
+        "from consensus_specs_tpu.ops.fq_tower import _apply_int_matrix\n"
+        "def f(gamma, a, b, c):\n"
+        "    P = F.fq_wide_norm(F.fq_mul_wide(a, b))\n"
+        "    t = F.fq_mul_wide(a, c)\n"
+        "    u = F.fq_mul_wide(b, c)\n"
+        "    shallow = t - u\n"
+        "    deep = P + P + P + P\n"
+        "    return _apply_int_matrix(gamma, P) + shallow + deep\n"
+    )
+    assert findings_for(tmp_path, src) == []
+
+
+def test_wide_accumulation_suppression(tmp_path):
+    src = (
+        "from consensus_specs_tpu.ops import fq as F\n"
+        "def f(a, b, c):\n"
+        "    # csa: ignore[CSA901] -- operands are half-width here\n"
+        "    return F.fq_mul_wide(a, b) + F.fq_mul_wide(a, c) + "
+        "F.fq_mul_wide(b, c)\n"
+    )
+    path = tmp_path / "s.py"
+    path.write_text(src)
+    report = analyze_paths([str(path)])
+    assert report.findings == []
+    assert [f.rule for f in report.suppressed] == ["CSA901"]
+
+
+# ---------------------------------------------------------------------------
 # CSA8xx spec drift (differential vs a reference tree)
 # ---------------------------------------------------------------------------
 
@@ -884,6 +958,9 @@ def test_cli_exit_codes_and_json(tmp_path):
                "    return pl.pallas_call(k, grid=(2, 2),\n"
                "        out_specs=pl.BlockSpec((8,), lambda i: (i,)),\n"
                "        interpret=True)(x)\n"),
+    ("CSA901", "def f(a, b, c):\n"
+               "    return (fq_mul_wide(a, b) + fq_mul_wide(a, c)\n"
+               "            + fq_mul_wide(b, c))\n"),
 ])
 def test_cli_nonzero_per_rule_class(tmp_path, rule_class, snippet):
     """Acceptance: injected fixtures for each per-module rule class exit

@@ -28,7 +28,10 @@ from dataclasses import dataclass, field, asdict
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional
 
-SEVERITIES = ("error", "warning")
+# "notice" findings are still actionable (the ratchet applies — suppress
+# with a justification when a flagged site is genuinely in budget); the
+# tier only signals that the rule is a heuristic, not a proof.
+SEVERITIES = ("error", "warning", "notice")
 
 _SUPPRESS_RE = re.compile(r"#\s*csa:\s*ignore\[([A-Za-z0-9_*,\s]+)\]")
 

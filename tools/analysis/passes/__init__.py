@@ -9,3 +9,4 @@ from . import jit_cache     # noqa: F401
 from . import sharding_collective  # noqa: F401
 from . import pallas_kernels  # noqa: F401
 from . import spec_drift    # noqa: F401
+from . import wide_accumulation  # noqa: F401

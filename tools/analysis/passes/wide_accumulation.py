@@ -1,0 +1,138 @@
+"""CSA901 — raw wide-column accumulation without an interposed carry round.
+
+The double-width lazy-Montgomery pipeline (ops/fq.py) keeps tower
+products as 2L int64 columns and reduces once per output coefficient.
+Raw `fq_mul_wide` columns reach 14*2^58 < 2^62, so summing MORE THAN TWO
+of them can exceed int64 (3 * 14 * 2^58 > 2^63) and wrap silently —
+corrupting every pairing built on top while still producing plausible
+limb arrays. The laziness contract therefore requires a value-preserving
+wide carry round (`fq_wide_norm` / `_carry_rounds`) between the
+schoolbook and any >2-term accumulation — including `_apply_int_matrix`
+gamma combinations, whose fan-in reaches 36.
+
+Simple per-function AST dataflow: a name assigned from
+`fq_mul_wide(...)` is tainted "raw wide" (weight 1); weights add through
++/- chains and rebinding; any other call (fq_wide_norm, fq_redc, ...)
+yields a fresh weight-0 value, which is how the interposed carry round
+clears the taint. Flagged: an Add/Sub accumulation whose total raw-wide
+weight exceeds 2, or a raw-wide value handed to an
+`_apply_int_matrix`-shaped callee. Notice severity: a site may still be
+in budget for other reasons (smaller operand bounds) — suppress with a
+justification if so.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, register_pass, register_rule
+
+register_rule(
+    "CSA901",
+    "fq_mul_wide columns accumulated >2 deep with no wide carry round",
+    "notice",
+    "raw wide columns reach 14*2^58; interpose fq_wide_norm (a value-"
+    "preserving wide carry round) before summing more than two or before "
+    "any _apply_int_matrix combination",
+)
+
+_WIDE_SOURCES = ("fq_mul_wide",)
+_MATRIX_CALLEES = ("_apply_int_matrix", "apply_int_matrix")
+
+
+def _callee(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+class _FnScanner:
+    """Statement-ordered taint walk of one function body (branch joins are
+    approximated by last-write-wins — fine for a notice-level heuristic)."""
+
+    def __init__(self, mod, fn):
+        self.mod = mod
+        self.fn = fn
+        self.weights = {}   # name -> raw-wide term count
+        self.findings = []
+
+    def weight(self, node) -> int:
+        """Raw-wide terms the expression contributes to an accumulation."""
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.Add, ast.Sub)):
+            return self.weight(node.left) + self.weight(node.right)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            # scalar * wide keeps the wide side's term count
+            return self.weight(node.left) + self.weight(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.weight(node.operand)
+        if isinstance(node, ast.Call):
+            return 1 if _callee(node) in _WIDE_SOURCES else 0
+        if isinstance(node, ast.Name):
+            return self.weights.get(node.id, 0)
+        return 0
+
+    def _flag_sum(self, w, lineno):
+        self.findings.append(Finding(
+            "CSA901", self.mod.path, lineno,
+            f"accumulation of {w} raw fq_mul_wide terms with no interposed "
+            f"wide carry round (int64 columns overflow beyond 2 terms)",
+            context=self.mod.qualname(self.fn)))
+
+    def check_expr(self, node, lineno):
+        w = self.weight(node)
+        if w > 2:
+            self._flag_sum(w, lineno)
+            return
+        for call in ast.walk(node):
+            if isinstance(call, ast.Call) and _callee(call) in _MATRIX_CALLEES:
+                if any(self.weight(arg) >= 1 for arg in call.args):
+                    self.findings.append(Finding(
+                        "CSA901", self.mod.path, call.lineno,
+                        "_apply_int_matrix over raw fq_mul_wide columns — "
+                        "interpose fq_wide_norm before the matrix "
+                        "combination", context=self.mod.qualname(self.fn)))
+
+    def run_stmts(self, body):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue   # nested defs get their own scan
+            if isinstance(stmt, ast.Assign):
+                self.check_expr(stmt.value, stmt.lineno)
+                # clamp the recorded weight so one over-budget site is
+                # flagged once, not again at every downstream use
+                w = min(self.weight(stmt.value), 2)
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        self.weights[target.id] = w
+            elif isinstance(stmt, ast.AugAssign):
+                self.check_expr(stmt.value, stmt.lineno)
+                if isinstance(stmt.target, ast.Name) and isinstance(
+                        stmt.op, (ast.Add, ast.Sub)):
+                    w = (self.weights.get(stmt.target.id, 0)
+                         + self.weight(stmt.value))
+                    if w > 2:
+                        self._flag_sum(w, stmt.lineno)
+                    self.weights[stmt.target.id] = min(w, 2)
+            elif isinstance(stmt, (ast.Expr, ast.Return)):
+                if stmt.value is not None:
+                    self.check_expr(stmt.value, stmt.lineno)
+            elif isinstance(stmt, (ast.For, ast.While, ast.If)):
+                self.run_stmts(stmt.body)
+                self.run_stmts(stmt.orelse)
+            elif isinstance(stmt, ast.With):
+                self.run_stmts(stmt.body)
+
+
+@register_pass
+def run(mod):
+    findings = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        scanner = _FnScanner(mod, node)
+        scanner.run_stmts(node.body)
+        findings.extend(scanner.findings)
+    return findings
