@@ -92,7 +92,7 @@ smoke:
 	$(PYTHON) -m tools.analysis consensus_specs_tpu bench.py __graft_entry__.py \
 		--baseline tools/analysis/baseline.json \
 		--reference-root $(REFERENCE_ROOT)
-	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_bench_probe.py -q -m "not slow"
+	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py -q -m "not slow"
 
 clean:
 	rm -rf out .pytest_cache $(VECTOR_DIR)

@@ -399,6 +399,75 @@ _stage_a_jit = partial(jax.jit, static_argnums=(0,))(_stage_a_traced)
 _stage_b_jit = partial(jax.jit, static_argnums=(0,))(_stage_b_traced)
 
 
+# ---------------------------------------------------------------------------
+# Inert validator padding (the sharded serving layout)
+#
+# jax pins shard sizes at placement time, so a `[V]` column sharded over the
+# serving mesh must have V divisible by the mesh size. The serving path pads
+# with INERT rows instead: a never-eligible, never-active, zero-balance
+# validator every mask in the traced program excludes —
+#   * active/eligible masks are False (activation == exit == FAR_FUTURE),
+#   * uint64 balance sums gain exact zeros (order-independent),
+#   * the activation-queue stable sort keys padding at FAR_FUTURE behind
+#     every real row (padding indices are the largest), so queued positions
+#     are unchanged,
+#   * the exit-queue base/count scans see exit_epoch == FAR (excluded), and
+#   * the proposer scatter-add receives a zero gain at index 0.
+# The `[V]` prefix of the padded program's outputs is therefore
+# bit-identical to the unpadded program (asserted differentially in
+# tests/test_multichip.py, including a non-divisible V).
+# ---------------------------------------------------------------------------
+
+def inert_column_tail(field: str, k: int, far: int) -> np.ndarray:
+    """[k] inert-validator rows for one ValidatorColumns field."""
+    if field in ("activation_eligibility_epoch", "activation_epoch",
+                 "exit_epoch", "withdrawable_epoch"):
+        return np.full(k, far, dtype=np.uint64)
+    if field == "slashed":
+        return np.zeros(k, dtype=bool)
+    return np.zeros(k, dtype=np.uint64)   # effective_balance, balance
+
+
+def pad_validator_columns(cols: ValidatorColumns, vp: int,
+                          far: int) -> ValidatorColumns:
+    """Pad [V] columns to [vp] rows with inert validators (see above)."""
+    V = int(cols.balance.shape[0])
+    k = vp - V
+    assert k >= 0, (vp, V)
+    if k == 0:
+        return cols
+    return ValidatorColumns(**{
+        f: jnp.concatenate([getattr(cols, f),
+                            jnp.asarray(inert_column_tail(f, k, far))])
+        for f in ValidatorColumns._fields})
+
+
+def pad_epoch_inputs(inp: EpochInputs, vp: int) -> EpochInputs:
+    """Pad the [V] participation facts to [vp] rows with the neutral
+    values build_epoch_inputs uses for non-participants (flags False,
+    inclusion delay 1, proposer 0, no crosslink committee); the two
+    replicated per-shard tables pass through."""
+    V = int(inp.prev_src.shape[0])
+    k = vp - V
+    assert k >= 0, (vp, V)
+    if k == 0:
+        return inp
+    f_bool = jnp.zeros(k, dtype=bool)
+    return inp._replace(
+        prev_src=jnp.concatenate([inp.prev_src, f_bool]),
+        prev_tgt=jnp.concatenate([inp.prev_tgt, f_bool]),
+        prev_head=jnp.concatenate([inp.prev_head, f_bool]),
+        curr_tgt=jnp.concatenate([inp.curr_tgt, f_bool]),
+        incl_delay=jnp.concatenate(
+            [inp.incl_delay, jnp.ones(k, dtype=jnp.uint64)]),
+        att_proposer=jnp.concatenate(
+            [inp.att_proposer, jnp.zeros(k, dtype=jnp.int32)]),
+        v_shard=jnp.concatenate(
+            [inp.v_shard, jnp.full(k, -1, dtype=jnp.int32)]),
+        in_winning=jnp.concatenate([inp.in_winning, f_bool]),
+    )
+
+
 # ===========================================================================
 # Host bridge: object-model state <-> SoA columns, input distillation
 # ===========================================================================

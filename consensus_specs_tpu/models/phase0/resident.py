@@ -58,13 +58,15 @@ import jax
 
 from ...utils.ssz import bulk
 from ...utils.ssz import impl as ssz_impl
-from ...utils.ssz.incremental import IncrementalMerkleTree
+from ...utils.ssz.incremental import (IncrementalMerkleTree,
+                                      ShardedIncrementalMerkleTree)
 from . import helpers as helpers_mod
 from .epoch_soa import (EpochConfig, ValidatorColumns, build_epoch_context,
                         build_epoch_inputs, columns_np_from_state,
-                        epoch_transition_device, process_crosslinks_vectorized,
-                        scalars_from_state, _apply_justification,
-                        _apply_validator_columns)
+                        epoch_transition_device, inert_column_tail,
+                        pad_epoch_inputs, pad_validator_columns,
+                        process_crosslinks_vectorized, scalars_from_state,
+                        _apply_justification, _apply_validator_columns)
 
 # Mirror columns the host-side spec logic reads between boundaries.
 _MIRROR_FIELDS = ("activation_epoch", "exit_epoch", "effective_balance",
@@ -113,14 +115,35 @@ def _common_path_block(block) -> bool:
                 or len(b.transfers))
 
 
-class ResidentCore:
-    """Holds the registry/balances on device across slots and epochs."""
+def _serving_mesh(mesh):
+    """Resolve the `mesh` ctor argument: "env" consults CSTPU_SERVING_MESH
+    (parallel.sharding.ServingMesh.from_env), None forces single-device,
+    anything else is used as the ServingMesh itself."""
+    if mesh == "env":
+        from ...parallel.sharding import ServingMesh
+        return ServingMesh.from_env()
+    return mesh
 
-    def __init__(self, spec, state):
+
+class ResidentCore:
+    """Holds the registry/balances on device across slots and epochs.
+
+    With `mesh` (a parallel.sharding.ServingMesh, or CSTPU_SERVING_MESH
+    set), the whole serving path runs under the validator-axis
+    NamedSharding: columns and participation facts shard over "v" (padded
+    to a mesh multiple with inert rows — epoch_soa.pad_validator_columns),
+    the incremental forests keep per-shard subtree levels on their shard
+    with a replicated cap tree, and every jitted program dispatches with
+    matched in/out shardings so chained slot and epoch steps never
+    re-lay-out. Roots and serialized states stay bit-identical to the
+    single-device core (tests/test_resident.py)."""
+
+    def __init__(self, spec, state, mesh="env"):
         if spec._insert_after_registry_updates or spec._insert_after_final_updates:
             raise NotImplementedError(
                 "resident mode covers the phase-0 fused epoch program; "
                 "phase-1 insert hooks take process_epoch_soa_staged")
+        self._mesh = _serving_mesh(mesh)
         self.spec = spec
         self.cfg = EpochConfig.from_spec(spec)
         self.state = state
@@ -140,7 +163,8 @@ class ResidentCore:
     # -- residency lifecycle ------------------------------------------------
 
     @classmethod
-    def from_checkpoint(cls, spec, state_bytes: bytes) -> "ResidentCore":
+    def from_checkpoint(cls, spec, state_bytes: bytes,
+                        mesh="env") -> "ResidentCore":
         """Resume a serialized BeaconState straight into residency without
         materializing the registry: the big fields parse as strided-view
         columns (utils/ssz/columns.py), everything else deserializes into
@@ -161,6 +185,7 @@ class ResidentCore:
         np_cols = state_columns_from_bytes(state_bytes, spec)
         state = light_state_from_bytes(spec, state_bytes)
         core = cls.__new__(cls)
+        core._mesh = _serving_mesh(mesh)
         core.spec = spec
         core.cfg = EpochConfig.from_spec(spec)
         core.timings = {}
@@ -187,14 +212,33 @@ class ResidentCore:
             np_cols["withdrawal_credentials"] = wc
         self.mirrors: Dict[str, np.ndarray] = {
             f: np_cols[f].copy() for f in _MIRROR_FIELDS}
-        self.cols = ValidatorColumns(
+        # _v is the LOGICAL validator count; under a serving mesh the
+        # device columns pad to the next mesh multiple with inert rows
+        self._v = int(np_cols["balance"].shape[0])
+        cols = ValidatorColumns(
             **{f: jnp.asarray(np_cols[f]) for f in _ALL_FIELDS})
         # identity columns never change while resident: keep host copies
         # for the checkpoint WRITE path alongside the device uploads
         self._pk_np = np.asarray(np_cols["pubkey"])
         self._wc_np = np.asarray(np_cols["withdrawal_credentials"])
-        self.pk_dev = jnp.asarray(self._pk_np)
-        self.wc_dev = jnp.asarray(self._wc_np)
+        if self._mesh is not None:
+            import jax
+            vp = self._mesh.pad_rows(self._v)
+            self.cols = jax.device_put(
+                pad_validator_columns(cols, vp,
+                                      int(self.spec.FAR_FUTURE_EPOCH)),
+                self._mesh.shard_v)
+            pad = np.zeros((vp - self._v, 48), np.uint8)
+            self.pk_dev = jax.device_put(
+                jnp.asarray(np.concatenate([self._pk_np, pad])),
+                self._mesh.shard_v)
+            self.wc_dev = jax.device_put(
+                jnp.asarray(np.concatenate([self._wc_np, pad[:, :32]])),
+                self._mesh.shard_v)
+        else:
+            self.cols = cols
+            self.pk_dev = jnp.asarray(self._pk_np)
+            self.wc_dev = jnp.asarray(self._wc_np)
         self._big_roots: Optional[tuple] = None
         # Per-column incremental Merkle forests (utils/ssz/incremental.py),
         # built lazily on the first root request; a fresh entry cannot reuse
@@ -230,9 +274,12 @@ class ResidentCore:
         return self.state
 
     def _materialize_np_cols(self) -> Dict[str, np.ndarray]:
-        """One download of the device columns as a host dict."""
+        """One download of the device columns as a host dict (sliced back
+        to the logical validator count — the inert padding rows of the
+        sharded layout never reach host consumers)."""
         cols = jax.device_get(self.cols)
-        return {f: np.asarray(getattr(cols, f)) for f in _ALL_FIELDS}
+        return {f: np.asarray(getattr(cols, f))[:self._v]
+                for f in _ALL_FIELDS}
 
     def checkpoint_bytes(self) -> bytes:
         """Serialize the resident state WITHOUT materializing the registry:
@@ -242,8 +289,7 @@ class ResidentCore:
         with from_checkpoint this round-trips the original bytes when no
         transition ran."""
         from ...utils.ssz.columns import state_bytes_from_columns
-        cols = jax.device_get(self.cols)
-        np_cols = {f: np.asarray(getattr(cols, f)) for f in _ALL_FIELDS}
+        np_cols = self._materialize_np_cols()
         np_cols["pubkey"] = self._pk_np
         np_cols["withdrawal_credentials"] = self._wc_np
         return state_bytes_from_columns(self.state, np_cols, self.spec)
@@ -304,9 +350,22 @@ class ResidentCore:
             self._wc_np = np.concatenate([self._wc_np, wc_new])
             # upload only the appended rows and concatenate ON DEVICE — a
             # one-validator deposit must not re-upload the ~80 MB identity
-            # matrices of a 1M-validator registry
-            self.pk_dev = jnp.concatenate([self.pk_dev, jnp.asarray(pk_new)])
-            self.wc_dev = jnp.concatenate([self.wc_dev, jnp.asarray(wc_new)])
+            # matrices of a 1M-validator registry. Under the serving mesh
+            # the rows SCATTER into the existing inert padding slots
+            # instead (zero upload beyond the rows themselves); only a
+            # capacity crossing concatenates and re-places.
+            if self._mesh is not None:
+                zeros = lambda k, w: np.zeros((k, w), np.uint8)  # noqa: E731
+                self.pk_dev = self._grow_sharded(
+                    self.pk_dev, pk_new, old_n, lambda k: zeros(k, 48))
+                self.wc_dev = self._grow_sharded(
+                    self.wc_dev, wc_new, old_n, lambda k: zeros(k, 32))
+            else:
+                self.pk_dev = jnp.concatenate(
+                    [self.pk_dev, jnp.asarray(pk_new)])
+                self.wc_dev = jnp.concatenate(
+                    [self.wc_dev, jnp.asarray(wc_new)])
+        far = int(self.spec.FAR_FUTURE_EPOCH)
         dirty: Dict[str, np.ndarray] = {}
         new_cols = {}
         for f in _ALL_FIELDS:
@@ -318,14 +377,38 @@ class ResidentCore:
                 dev = dev.at[jnp.asarray(idx.astype(np.int32))].set(
                     jnp.asarray(new[idx]))
             if grown:
-                dev = jnp.concatenate([dev, jnp.asarray(new[old_n:])])
+                if self._mesh is not None:
+                    dev = self._grow_sharded(
+                        dev, new[old_n:], old_n,
+                        lambda k, _f=f: inert_column_tail(_f, k, far))
+                else:
+                    dev = jnp.concatenate([dev, jnp.asarray(new[old_n:])])
             new_cols[f] = dev
         self.cols = ValidatorColumns(**new_cols)
+        self._v = new_n
         self.mirrors = {f: np_cols[f].copy() for f in _MIRROR_FIELDS}
         self._active_idx_memo.clear()
         self._update_forests(np_cols, old_n, dirty)
         self._big_roots = None
         self._install()
+
+    def _grow_sharded(self, dev, rows_np, old_n: int, tail_fn):
+        """Grow one padded sharded column from logical `old_n` to
+        `old_n + len(rows_np)`: scatter the new rows into the inert
+        padding slots; when the padded capacity itself must reach the
+        next mesh multiple, extend with `tail_fn(k)` inert rows and
+        re-place — the only step that re-lays-out, and it happens once
+        per mesh-multiple of growth, not per deposit."""
+        import jax
+        import jax.numpy as jnp
+        new_n = old_n + int(rows_np.shape[0])
+        vp_new = self._mesh.pad_rows(new_n)
+        if vp_new > int(dev.shape[0]):
+            tail = jnp.asarray(tail_fn(vp_new - int(dev.shape[0])))
+            dev = jax.device_put(jnp.concatenate([dev, tail]),
+                                 self._mesh.shard_v)
+        idx = jnp.asarray(np.arange(old_n, new_n, dtype=np.int32))
+        return dev.at[idx].set(jnp.asarray(rows_np))
 
     # registry-leaf fields: everything the Validator container Merkleizes
     # except the separate balances list (pubkey/wc never change in place)
@@ -467,7 +550,7 @@ class ResidentCore:
         if self._big_roots is not None:
             return self._big_roots
         c = self.cols
-        V = int(c.balance.shape[0])
+        V = self._v
         if V == 0 or self.pk_dev.shape[0] == 0:
             # degenerate metadata-only state: the numpy oracle short-circuit
             self._big_roots = bulk.registry_and_balances_roots_device(
@@ -475,18 +558,35 @@ class ResidentCore:
                 c.activation_epoch, c.exit_epoch, c.withdrawable_epoch,
                 c.slashed, c.effective_balance, c.balance)
             return self._big_roots
-        if self._reg_forest is None:
-            self._reg_forest = IncrementalMerkleTree(
-                bulk.registry_leaf_words_device(
-                    self.pk_dev, self.wc_dev, c.activation_eligibility_epoch,
-                    c.activation_epoch, c.exit_epoch, c.withdrawable_epoch,
-                    c.slashed, c.effective_balance))
-        if self._bal_forest is None:
-            self._bal_forest = IncrementalMerkleTree(
-                bulk.balances_chunk_words_device(c.balance))
+        if self._mesh is not None:
+            # sharded forests: level 0 built by the mesh's placed leaf
+            # programs (inert padding rows masked to the SSZ virtual-zero
+            # rows), per-shard subtree levels resident on their shard
+            if self._reg_forest is None:
+                self._reg_forest = ShardedIncrementalMerkleTree(
+                    self._mesh.registry_forest_leaves(
+                        self.pk_dev, self.wc_dev,
+                        c.activation_eligibility_epoch, c.activation_epoch,
+                        c.exit_epoch, c.withdrawable_epoch, c.slashed,
+                        c.effective_balance, v_count=V),
+                    self._mesh, logical_n=V)
+            if self._bal_forest is None:
+                self._bal_forest = ShardedIncrementalMerkleTree(
+                    self._mesh.balances_forest_chunks(c.balance, V),
+                    self._mesh, logical_n=max(1, -(-V // 4)))
+        else:
+            if self._reg_forest is None:
+                self._reg_forest = IncrementalMerkleTree(
+                    bulk.registry_leaf_words_device(
+                        self.pk_dev, self.wc_dev,
+                        c.activation_eligibility_epoch, c.activation_epoch,
+                        c.exit_epoch, c.withdrawable_epoch, c.slashed,
+                        c.effective_balance))
+            if self._bal_forest is None:
+                self._bal_forest = IncrementalMerkleTree(
+                    bulk.balances_chunk_words_device(c.balance))
         self._big_roots = (
-            ssz_impl.mix_in_length(self._reg_forest.root(),
-                                   self.pk_dev.shape[0]),
+            ssz_impl.mix_in_length(self._reg_forest.root(), V),
             ssz_impl.mix_in_length(self._bal_forest.root(), V))
         return self._big_roots
 
@@ -594,12 +694,22 @@ class ResidentCore:
         process_crosslinks_vectorized(spec, state, ctx)
         inp = build_epoch_inputs(spec, state, ctx)
         scal = scalars_from_state(state)
+        if self._mesh is not None:
+            # pad the [V] facts to the columns' padded row count; the
+            # epoch jit's in_shardings place them on the mesh
+            inp = pad_epoch_inputs(inp, int(self.cols.balance.shape[0]))
         for leaf in jax.tree_util.tree_leaves((scal, inp)):
             np.asarray(leaf.ravel()[0:1])   # fence uploads into "stage"
         t1 = _time.perf_counter()
 
-        dev_cols, dev_scal, dev_report = epoch_transition_device(
-            self.cfg, self.cols, scal, inp)
+        if self._mesh is not None:
+            # matched in/out shardings: this boundary's output columns are
+            # the next boundary's inputs with ZERO re-layout between them
+            dev_cols, dev_scal, dev_report = self._mesh.epoch_transition(
+                self.cfg, self.cols, scal, inp)
+        else:
+            dev_cols, dev_scal, dev_report = epoch_transition_device(
+                self.cfg, self.cols, scal, inp)
         np.asarray(dev_cols.balance[0:1])   # output fence
         t2 = _time.perf_counter()
 
@@ -617,9 +727,11 @@ class ResidentCore:
             int(x) for x in np.asarray(new_scal.latest_slashed_balances)]
         state.latest_start_shard = int(new_scal.latest_start_shard)
         # refresh ONLY the columns host logic reads; slashed never changes
-        # in the epoch program, balances stay device-only
+        # in the epoch program, balances stay device-only (the [:_v] slice
+        # drops the sharded layout's inert padding rows)
         for f in ("activation_epoch", "exit_epoch", "effective_balance"):
-            self.mirrors[f] = np.asarray(jax.device_get(getattr(dev_cols, f)))
+            self.mirrors[f] = np.asarray(
+                jax.device_get(getattr(dev_cols, f)))[:self._v]
         spec.final_updates_byte_rooted(state)   # the resident override
         # prune attestation-root memo entries the rotation dropped
         live = {id(a) for a in state.previous_epoch_attestations}

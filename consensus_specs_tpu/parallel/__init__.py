@@ -8,5 +8,5 @@ the driver dry-run, the pytest mesh suite, and production entry points all
 stage state identically.
 """
 from .sharding import (  # noqa: F401
-    shard_epoch_state, shard_leading_axis, trees_bitwise_equal,
-    validator_mesh)
+    ServingMesh, pad_leading_pow2, pow2_pad_rows, shard_epoch_state,
+    shard_leading_axis, trees_bitwise_equal, validator_mesh)

@@ -10,12 +10,18 @@ embarrassingly-parallel axis):
 """
 from __future__ import annotations
 
+import os
+from functools import partial
+from typing import Dict, Optional
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.phase0.epoch_soa import (
-    EpochInputs, EpochScalars, ValidatorColumns)
+    EpochInputs, EpochReport, EpochScalars, ValidatorColumns,
+    _epoch_transition_traced)
+from ..utils.merkle import next_power_of_two
 
 
 def validator_mesh(devices=None, n: int = None) -> Mesh:
@@ -28,6 +34,14 @@ def validator_mesh(devices=None, n: int = None) -> Mesh:
     return Mesh(np.asarray(devices), axis_names=("v",))
 
 
+# EpochInputs placement convention: every field is a [V] participation-fact
+# column EXCEPT the trailing two per-shard balance tables, which replicate.
+# Single definition shared by shard_epoch_state and ServingMesh.
+_N_INPUT_VCOLS = len(EpochInputs._fields) - 2
+assert EpochInputs._fields[_N_INPUT_VCOLS:] == (
+    "shard_att_balance", "shard_comm_balance")
+
+
 def shard_epoch_state(mesh: Mesh, cols: ValidatorColumns, scal: EpochScalars,
                       inp: EpochInputs):
     """Place one epoch step's inputs per the contract above."""
@@ -35,7 +49,7 @@ def shard_epoch_state(mesh: Mesh, cols: ValidatorColumns, scal: EpochScalars,
     repl = NamedSharding(mesh, P())
     cols_s = ValidatorColumns(*(jax.device_put(x, shard_v) for x in cols))
     scal_s = EpochScalars(*(jax.device_put(x, repl) for x in scal))
-    n_vcols = len(EpochInputs._fields) - 2   # trailing 2 are shard tables
+    n_vcols = _N_INPUT_VCOLS
     inp_s = EpochInputs(
         *(jax.device_put(x, shard_v) for x in inp[:n_vcols]),
         shard_att_balance=jax.device_put(inp.shard_att_balance, repl),
@@ -82,6 +96,31 @@ def shard_hierarchical(mesh: Mesh, tree):
         tree)
 
 
+def pow2_pad_rows(n: int, mesh_size: int) -> int:
+    """The next power of two >= max(n, 1) — because the serving mesh size
+    is itself a power of two, the result is a multiple of it whenever it
+    is at least the mesh size. This is the row count the sharded forests
+    materialize per level and the append-grow target (ISSUE: the
+    append-grow pow2 padding must round to a multiple of the mesh size)."""
+    assert mesh_size & (mesh_size - 1) == 0, \
+        f"mesh size must be a power of two, got {mesh_size}"
+    return next_power_of_two(max(n, 1))
+
+
+def pad_leading_pow2(x, mesh: Mesh):
+    """Zero-pad an array's leading axis to pow2_pad_rows so it becomes
+    shardable over the mesh — the helper `shard_leading_axis` names when
+    it rejects a non-divisible axis. Callers that need non-zero padding
+    semantics (inert validator rows) pad themselves before sharding."""
+    import jax.numpy as jnp
+    n = x.shape[0]
+    m = pow2_pad_rows(n, mesh.devices.size)
+    if m == n:
+        return x
+    return jnp.concatenate(
+        [x, jnp.zeros((m - n,) + tuple(x.shape[1:]), dtype=x.dtype)])
+
+
 def shard_leading_axis(mesh: Mesh, tree):
     """Shard every leaf's LEADING axis over the mesh's "v" axis.
 
@@ -90,13 +129,236 @@ def shard_leading_axis(mesh: Mesh, tree):
     group's pair product is independent — no cross-device traffic until
     the final verdict gather) and the leaf axis of the bulk Merkleizer
     (the reduction tree halves locally until the level fits one device,
-    then XLA inserts the cross-device combines). 0-d leaves replicate."""
+    then XLA inserts the cross-device combines). 0-d leaves replicate.
+
+    Leading axes must divide the mesh size: this jax pins shard sizes at
+    placement time, so a non-divisible axis would make pjit pad (or
+    reject) unpredictably per jax version. Pad explicitly first —
+    `pad_leading_pow2(x, mesh)` gives the pow2 row count every sharded
+    consumer here (forests, serving columns) already uses."""
+    size = int(mesh.devices.size)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        n = getattr(leaf, "shape", (0,))[0] if getattr(leaf, "ndim", 0) else None
+        if n is not None and n % size:
+            if size & (size - 1) == 0:
+                hint = next_power_of_two(max(n, 1))
+                while hint % size:        # pow2 size: terminates at >= size
+                    hint *= 2
+                how = f"e.g. pad_leading_pow2 to {hint} rows"
+            else:                         # non-pow2 mesh: next multiple
+                how = f"e.g. zero-pad to {-(-n // size) * size} rows"
+            raise ValueError(
+                f"shard_leading_axis: leading axis of {n} rows does not "
+                f"divide the {size}-device mesh — pad first ({how}) "
+                f"instead of letting pjit pad unpredictably")
     shard = NamedSharding(mesh, P("v"))
     repl = NamedSharding(mesh, P())
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(
             x, shard if getattr(x, "ndim", 0) >= 1 else repl),
         tree)
+
+
+class ServingMesh:
+    """Placement layer for the resident serving loop (ROADMAP item 1).
+
+    Owns the validator-axis mesh and produces MATCHED in/out shardings for
+    every jitted program ResidentCore dispatches, so chained per-slot and
+    per-epoch steps pass device arrays straight through without re-layout —
+    the staged-pjit contract of SNIPPETS.md [1][2]: a producer's
+    out_shardings must be the next call's in_shardings. Placement policy:
+
+      - every `[Vp]` validator column (and the `[Vp]` participation facts)
+        shards over "v"; Vp is the logical validator count padded up to a
+        multiple of the mesh size with INERT rows (never-activated,
+        zero-balance validators the epoch program's masks exclude — jax
+        pins shard sizes at placement, so the axis must divide the mesh);
+      - scalars, the shard-balance tables, and the epoch report replicate;
+      - forest levels shard while their row count divides the mesh and
+        replicate above that (the tiny cap tree).
+    """
+
+    def __init__(self, mesh: Mesh):
+        assert mesh.axis_names == ("v",), mesh.axis_names
+        size = int(mesh.devices.size)
+        assert size & (size - 1) == 0, \
+            f"serving mesh size must be a power of two, got {size}"
+        self.mesh = mesh
+        self.shard_v = NamedSharding(mesh, P("v"))
+        self.replicated = NamedSharding(mesh, P())
+        self._jits: Dict = {}
+
+    @property
+    def size(self) -> int:
+        return int(self.mesh.devices.size)
+
+    @classmethod
+    def create(cls, n: int = None) -> "ServingMesh":
+        return cls(validator_mesh(n=n))
+
+    @classmethod
+    def from_env(cls) -> Optional["ServingMesh"]:
+        """CSTPU_SERVING_MESH knob: unset/""/"0"/"off" -> single-device
+        (None); "all" -> the largest power-of-two device count available;
+        an integer -> exactly that many devices (must be a power of two —
+        an explicit ask is honored or refused, never silently rounded).
+        A 1-device request also resolves to None (nothing to shard)."""
+        spec = os.environ.get("CSTPU_SERVING_MESH", "").strip().lower()
+        if spec in ("", "0", "off", "none"):
+            return None
+        if spec == "all":
+            n = 1
+            while n * 2 <= len(jax.devices()):
+                n *= 2
+        else:
+            try:
+                n = int(spec)
+            except ValueError:
+                raise ValueError(
+                    f"CSTPU_SERVING_MESH={spec!r}: expected an integer "
+                    f"device count, 'all', or '0'/'off'") from None
+            if n > 1 and n & (n - 1):
+                raise ValueError(
+                    f"CSTPU_SERVING_MESH={n}: the serving mesh size must "
+                    f"be a power of two (forest levels halve per tree "
+                    f"level); use {1 << (n.bit_length() - 1)} or 'all'")
+        if n <= 1:
+            return None
+        return cls.create(n)
+
+    # -- padding ------------------------------------------------------------
+
+    def pad_rows(self, n: int) -> int:
+        """Smallest multiple of the mesh size >= n (the padded column
+        length Vp for a logical registry of n validators)."""
+        return -(-n // self.size) * self.size
+
+    def row_sharding(self, rows: int) -> NamedSharding:
+        """Forest-level placement: shard a level over "v" while its row
+        count divides the mesh, replicate the (tiny) cap levels above."""
+        return self.shard_v if rows and rows % self.size == 0 \
+            else self.replicated
+
+    # -- epoch program ------------------------------------------------------
+
+    def epoch_shardings(self):
+        """(cols, scal, inp) placement pytrees — the epoch program's
+        in_shardings AND (for cols/scal) its out_shardings."""
+        return (
+            ValidatorColumns(*([self.shard_v] * len(ValidatorColumns._fields))),
+            EpochScalars(*([self.replicated] * len(EpochScalars._fields))),
+            EpochInputs(*([self.shard_v] * _N_INPUT_VCOLS),
+                        shard_att_balance=self.replicated,
+                        shard_comm_balance=self.replicated),
+        )
+
+    def epoch_transition(self, cfg, cols, scal, inp):
+        """The fused epoch program with matched in/out shardings: sharded
+        `[Vp]` columns in, sharded `[Vp]` columns out, so consecutive
+        boundaries chain with zero re-layout. Donation is per shard on
+        accelerator backends (each device's column shard is rewritten in
+        place); XLA:CPU stays undonated for the same persistent-cache
+        aliasing reason as epoch_soa.epoch_transition_device."""
+        donate = jax.default_backend() != "cpu"
+        key = ("epoch", cfg, donate)
+        fn = self._jits.get(key)
+        if fn is None:
+            cols_sh, scal_sh, inp_sh = self.epoch_shardings()
+            report_sh = EpochReport(
+                *([self.replicated] * len(EpochReport._fields)))
+            fn = jax.jit(
+                partial(_epoch_transition_traced, cfg),
+                in_shardings=(cols_sh, scal_sh, inp_sh),
+                out_shardings=(cols_sh, scal_sh, report_sh),
+                donate_argnums=(0,) if donate else ())
+            self._jits[key] = fn
+        return fn(cols, scal, inp)
+
+    # -- forest level-0 builders --------------------------------------------
+
+    def registry_forest_leaves(self, pubkeys, withdrawal_credentials,
+                               activation_eligibility_epoch, activation_epoch,
+                               exit_epoch, withdrawable_epoch, slashed,
+                               effective_balance, v_count: int):
+        """[P2, 8] sharded level-0 rows of the registry forest from padded
+        `[Vp]` device columns: validator hash_tree_root words for rows
+        below the LOGICAL count, zero rows (the SSZ virtual padding)
+        beyond — P2 = pow2_pad_rows(v_count), a multiple of the mesh size
+        whenever it reaches it. v_count rides as a traced scalar so a
+        deposit that grows the registry inside the same padding re-uses
+        the compiled program."""
+        import jax.numpy as jnp
+        from ..utils.ssz.bulk import _registry_leaf_words
+
+        vp = int(pubkeys.shape[0])
+        p2 = pow2_pad_rows(v_count, self.size)
+        key = ("regleaves", vp, p2)
+        fn = self._jits.get(key)
+        if fn is None:
+            def traced(pk, wc, a, b, c, d, s, eb, n_valid):
+                leaves = _registry_leaf_words(pk, wc, a, b, c, d, s, eb)
+                mask = jnp.arange(vp, dtype=jnp.int32)[:, None] < n_valid
+                leaves = jnp.where(mask, leaves, jnp.uint32(0))
+                if p2 > vp:
+                    leaves = jnp.concatenate(
+                        [leaves, jnp.zeros((p2 - vp, 8), dtype=jnp.uint32)])
+                return leaves[:p2]
+            fn = jax.jit(
+                traced,
+                in_shardings=tuple([self.shard_v] * 8) + (self.replicated,),
+                out_shardings=self.row_sharding(p2))
+            self._jits[key] = fn
+        return fn(pubkeys, withdrawal_credentials,
+                  activation_eligibility_epoch, activation_epoch,
+                  exit_epoch, withdrawable_epoch, slashed,
+                  effective_balance, np.int32(v_count))
+
+    def balances_forest_chunks(self, balances, v_count: int):
+        """[P2c, 8] sharded level-0 rows of the balances forest from the
+        padded `[Vp]` balance column. Inert padding rows hold balance 0,
+        which IS the SSZ pack's virtual zero padding, so no masking is
+        needed — only the pow2 row padding."""
+        import jax.numpy as jnp
+        from ..utils.ssz.bulk import _balances_chunk_words
+
+        vp = int(balances.shape[0])
+        c = max(1, -(-v_count // 4))
+        p2 = pow2_pad_rows(c, self.size)
+        key = ("balchunks", vp, p2)
+        fn = self._jits.get(key)
+        if fn is None:
+            def traced(bal):
+                chunks = _balances_chunk_words(bal)
+                if p2 > chunks.shape[0]:
+                    chunks = jnp.concatenate(
+                        [chunks,
+                         jnp.zeros((p2 - chunks.shape[0], 8),
+                                   dtype=jnp.uint32)])
+                return chunks[:p2]
+            fn = jax.jit(traced, in_shardings=(self.shard_v,),
+                         out_shardings=self.row_sharding(p2))
+            self._jits[key] = fn
+        return fn(balances)
+
+    def forest_build_jit(self, capacity: int):
+        """One traced program building EVERY level of a pow2 `capacity`-leaf
+        forest, each level placed per row_sharding — per-shard subtree
+        levels stay on their shard, the cap levels replicate (the join of
+        the per-shard roots happens once, inside this program)."""
+        from ..utils.ssz.incremental import _build_levels
+        from ..utils.merkle import tree_depth
+
+        assert capacity & (capacity - 1) == 0, capacity
+        key = ("build", capacity)
+        fn = self._jits.get(key)
+        if fn is None:
+            out_sh = tuple(self.row_sharding(capacity >> d)
+                           for d in range(tree_depth(capacity) + 1))
+            fn = jax.jit(_build_levels,
+                         in_shardings=(self.row_sharding(capacity),),
+                         out_shardings=out_sh)
+            self._jits[key] = fn
+        return fn
 
 
 def trees_bitwise_equal(a, b) -> bool:

@@ -260,6 +260,126 @@ class IncrementalMerkleTree:
         return words_to_bytes(np.asarray(self.levels[-1][0])).tobytes()
 
 
+class ShardedIncrementalMerkleTree(IncrementalMerkleTree):
+    """The forest under a validator-axis ServingMesh (ROADMAP item 1):
+    per-shard subtree levels stay RESIDENT ON THEIR SHARD, a tiny
+    replicated cap tree joins the per-shard roots, and update/append
+    scatter only into the owning shard (a scatter with replicated updates
+    into a sharded operand keeps the operand's placement — each device
+    rewrites its own rows).
+
+    Layout contract vs the single-device tree: jax pins shard sizes at
+    placement time, so every level MATERIALIZES its pow2 padding (zerohash
+    rows) instead of keeping it virtual — capacity is always
+    next_power_of_two(logical n), which rounds to a multiple of the mesh
+    size by construction (both are powers of two), exactly the append-grow
+    contract. A level shards over "v" while its row count divides the mesh
+    and replicates above that (the cap). Padding rows equal the virtual
+    zerohash rows they replace, so every stored node — and the root — is
+    bit-identical to the single-device tree (tests/test_multichip.py).
+
+    `placement` is a parallel.sharding.ServingMesh (duck-typed: needs
+    row_sharding / forest_build_jit / size).
+    """
+
+    def __init__(self, leaf_words, placement, pair_fn=None,
+                 logical_n: int = None):
+        import jax.numpy as jnp
+        self._placement = placement
+        leaf_words = jnp.asarray(leaf_words, jnp.uint32)
+        assert leaf_words.ndim == 2 and leaf_words.shape[1] == 8, \
+            leaf_words.shape
+        rows = int(leaf_words.shape[0])
+        if logical_n is None:
+            # raw leaves: pad to pow2 here (zero rows == zerohash level 0)
+            logical_n = rows
+            cap = next_power_of_two(max(rows, 1))
+            if cap > rows:
+                leaf_words = jnp.concatenate(
+                    [leaf_words, jnp.zeros((cap - rows, 8), jnp.uint32)])
+        else:
+            assert rows == next_power_of_two(max(logical_n, 1)), \
+                (rows, logical_n)
+        self._n = int(logical_n)
+        level0 = jax.device_put(
+            leaf_words, placement.row_sharding(int(leaf_words.shape[0])))
+        self._pair_fn = pair_fn
+        self.last_pairs_per_level = []
+        self.total_pairs_hashed = 0
+        self.builds = 0
+        self.levels = [level0]
+        self._build()
+
+    @property
+    def n(self) -> int:
+        # logical leaf count: capacity is levels[0].shape[0]; update()'s
+        # range check and root()'s emptiness check both want the logical n
+        return self._n
+
+    def _build(self) -> None:
+        self.builds += 1
+        self.last_pairs_per_level = []
+        level = self.levels[0]
+        del self.levels[1:]
+        depth = tree_depth(int(level.shape[0]))
+        if depth == 0:
+            return
+        if self._pair_fn is None and merkle_pair_backend_name() == "xla":
+            # one traced program, every level placed per row_sharding
+            fn = self._placement.forest_build_jit(int(level.shape[0]))
+            self.levels = list(fn(level))
+            for d in range(depth):
+                self._count(d, self.levels[d].shape[0] // 2)
+            return
+        for d in range(depth):
+            pairs = level.reshape(-1, 16)
+            level = jax.device_put(
+                self._hash(pairs),
+                self._placement.row_sharding(pairs.shape[0]))
+            self._count(d, pairs.shape[0])
+            self.levels.append(level)
+
+    # update() is inherited verbatim: with pow2-materialized levels the
+    # odd-tail/virtual-row branches of _rehash_paths never trigger, the
+    # level scatters preserve each level's placement, and the `n` property
+    # above keeps the range check at the logical leaf count.
+
+    def append(self, rows_words) -> None:
+        """Append leaves: scatter into the materialized padding while it
+        lasts; crossing the padded power of two grows every level with
+        zerohash rows (they cover only virtual zero leaves, whose value
+        zerohash[d] already is), re-places it on the mesh — the one step
+        that re-lays-out, and the new capacity rounds to a multiple of the
+        mesh size by pow2 construction — and deepens the cap."""
+        import jax.numpy as jnp
+        rows = jnp.asarray(rows_words, jnp.uint32).reshape(-1, 8)
+        k = int(rows.shape[0])
+        if k == 0:
+            self.last_pairs_per_level = []
+            return
+        old_n = self._n
+        new_n = old_n + k
+        cap = int(self.levels[0].shape[0])
+        if new_n > cap:
+            new_cap = next_power_of_two(new_n)
+            for d in range(len(self.levels)):
+                n_d = new_cap >> d
+                lvl = jnp.concatenate(
+                    [self.levels[d],
+                     _zero_rows(d, n_d - int(self.levels[d].shape[0]))])
+                self.levels[d] = jax.device_put(
+                    lvl, self._placement.row_sharding(n_d))
+            for d in range(len(self.levels), tree_depth(new_cap) + 1):
+                n_d = new_cap >> d
+                self.levels.append(jax.device_put(
+                    _zero_rows(d, n_d), self._placement.row_sharding(n_d)))
+        self._n = new_n
+        idx = np.arange(old_n, new_n, dtype=np.int32)
+        self.levels[0] = _scatter_rows(self.levels[0], jnp.asarray(idx), rows)
+        self.last_pairs_per_level = []
+        self._rehash_paths(idx)
+
+
 def tree_from_chunks(chunks: np.ndarray,
                      pair_fn=None) -> IncrementalMerkleTree:
     """[n, 32] uint8 chunk matrix -> forest (byte-level convenience)."""

@@ -530,6 +530,94 @@ def test_sharding_negative_consistent_producer_consumer(tmp_path):
     assert findings_for(tmp_path, src) == []
 
 
+def test_sharding_flags_chained_jit_sharding_mismatch(tmp_path):
+    """CSA605: a jitted producer's out_shardings feeding a jitted consumer
+    whose in_shardings disagree at that argument position — the serving-
+    loop contract (SNIPPETS.md [1]) checked statically."""
+    src = (
+        "import jax\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "mesh = Mesh(None, axis_names=('v',))\n"
+        "def serve(x):\n"
+        "    step = jax.jit(lambda a: a,\n"
+        "                   in_shardings=NamedSharding(mesh, P('v')),\n"
+        "                   out_shardings=NamedSharding(mesh, P('v')))\n"
+        "    gather = jax.jit(lambda a: a,\n"
+        "                     in_shardings=NamedSharding(mesh, P()),\n"
+        "                     out_shardings=NamedSharding(mesh, P()))\n"
+        "    y = step(x)\n"
+        "    return gather(y)\n"        # P('v') output into P() input
+    )
+    assert rule_ids(findings_for(tmp_path, src)) == ["CSA605"]
+
+
+def test_sharding_negative_chained_jit_matched_shardings(tmp_path):
+    """Matched out/in shardings — including specs named by a constant and
+    tuple outputs unpacked into the next call — produce no finding."""
+    src = (
+        "import jax\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "mesh = Mesh(None, axis_names=('v',))\n"
+        "SH = NamedSharding(mesh, P('v'))\n"
+        "def serve(x, s):\n"
+        "    step = jax.jit(lambda a, b: (a, b),\n"
+        "                   in_shardings=(SH, NamedSharding(mesh, P())),\n"
+        "                   out_shardings=(NamedSharding(mesh, P('v')),\n"
+        "                                  NamedSharding(mesh, P())))\n"
+        "    cols, scal = step(x, s)\n"
+        "    cols, scal = step(cols, scal)\n"   # chained, matched per-arg
+        "    return cols\n"
+    )
+    assert findings_for(tmp_path, src) == []
+
+
+def test_sharding_negative_chained_jit_rebound_value(tmp_path):
+    """An explicit re-layout (or any rebinding) between producer and
+    consumer invalidates the recorded out-sharding — deliberate gathers
+    must not be flagged as implicit reshards."""
+    src = (
+        "import jax\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "mesh = Mesh(None, axis_names=('v',))\n"
+        "def serve(x):\n"
+        "    step = jax.jit(lambda a: a,\n"
+        "                   in_shardings=NamedSharding(mesh, P('v')),\n"
+        "                   out_shardings=NamedSharding(mesh, P('v')))\n"
+        "    gather = jax.jit(lambda a: a,\n"
+        "                     in_shardings=NamedSharding(mesh, P()))\n"
+        "    y = step(x)\n"
+        "    y = jax.device_put(y, NamedSharding(mesh, P()))\n"
+        "    return gather(y)\n"       # explicit re-layout: no finding
+    )
+    assert findings_for(tmp_path, src) == []
+    # non-Assign rebindings (AugAssign here) invalidate the same way
+    src_aug = src.replace(
+        "    y = jax.device_put(y, NamedSharding(mesh, P()))\n",
+        "    y += 1\n")
+    assert findings_for(tmp_path, src_aug) == []
+
+
+def test_sharding_chained_jit_mismatch_suppressible(tmp_path):
+    src = (
+        "import jax\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "mesh = Mesh(None, axis_names=('v',))\n"
+        "def serve(x):\n"
+        "    step = jax.jit(lambda a: a,\n"
+        "                   in_shardings=NamedSharding(mesh, P('v')),\n"
+        "                   out_shardings=NamedSharding(mesh, P('v')))\n"
+        "    gather = jax.jit(lambda a: a,\n"
+        "                     in_shardings=NamedSharding(mesh, P()))\n"
+        "    y = step(x)\n"
+        "    return gather(y)  # csa: ignore[CSA605] -- one-shot download\n"
+    )
+    path = tmp_path / "s.py"
+    path.write_text(src)
+    report = analyze_paths([str(path)])
+    assert report.findings == []
+    assert [f.rule for f in report.suppressed] == ["CSA605"]
+
+
 # ---------------------------------------------------------------------------
 # CSA7xx pallas kernel constraints
 # ---------------------------------------------------------------------------

@@ -60,6 +60,24 @@ def test_probe_hang_falls_through_to_cpu_smoke(monkeypatch):
     assert len(calls) == 2 and calls[0] is None and calls[1] is not None
 
 
+def test_probe_require_accel_refuses_cpu_fallback(monkeypatch):
+    """CSTPU_BENCH_REQUIRE_ACCEL=1: a dead accelerator must exit nonzero
+    instead of demoting to the CPU smoke shape — the knob that makes
+    BENCH_r03-r05-style silent fallbacks impossible for driver captures."""
+    def fake_child(code, timeout_s, env=None):
+        assert env is None, "must not even re-probe the CPU"
+        return None, "", ""               # device probe hangs
+
+    monkeypatch.setattr(bench, "_run_probe_child", fake_child)
+    monkeypatch.setattr(bench, "_CPU_FALLBACK", False)
+    monkeypatch.setenv("CSTPU_BENCH_CPU", "")
+    monkeypatch.setenv("CSTPU_BENCH_REQUIRE_ACCEL", "1")
+    with pytest.raises(SystemExit) as exc:
+        bench._probe_backend(timeout_s=1)
+    assert exc.value.code == 3
+    assert bench._CPU_FALLBACK is False   # no silent demotion happened
+
+
 def test_probe_cpu_unreachable_still_aborts(monkeypatch):
     """Only a dead CPU backend (nothing to fall back to) may exit 2."""
     def fake_child(code, timeout_s, env=None):

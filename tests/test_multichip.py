@@ -126,6 +126,142 @@ def test_sharded_output_stays_sharded(mesh):
     assert out_cols.balance.sharding.is_equivalent_to(shard_v, out_cols.balance.ndim)
 
 
+@pytest.fixture(scope="module")
+def serving_mesh():
+    from consensus_specs_tpu.parallel.sharding import ServingMesh
+    if len(jax.devices()) < N_DEV:
+        pytest.skip(f"needs {N_DEV} devices, have {len(jax.devices())}")
+    return ServingMesh.create(N_DEV)
+
+
+def test_sharded_forest_matches_single(serving_mesh):
+    """The incremental forest under the ServingMesh: per-shard subtree
+    levels sharded over "v", replicated cap tree, and every root — build,
+    scattered update, append-grow crossing both the padded power of two
+    AND a shard boundary — bit-identical to the single-device tree, at
+    the same O(dirty·log V) pair-lane bound."""
+    import jax.numpy as jnp
+    from consensus_specs_tpu.utils.ssz.incremental import (
+        IncrementalMerkleTree, ShardedIncrementalMerkleTree)
+
+    mesh = serving_mesh
+    rng = np.random.default_rng(21)
+    V = 100                         # deliberately not pow2, not 8-divisible
+    leaves = rng.integers(0, 2 ** 32, (V, 8), dtype=np.uint32)
+    single = IncrementalMerkleTree(leaves.copy())
+    shard = ShardedIncrementalMerkleTree(jnp.asarray(leaves), mesh)
+    assert shard.root() == single.root()
+    assert shard.n == single.n == V
+    assert shard.depth == single.depth
+    # materialized pow2 level 0 shards over "v"; the cap levels replicate
+    assert shard.levels[0].shape == (128, 8)
+    assert shard.levels[0].sharding.is_equivalent_to(mesh.shard_v, 2)
+    assert shard.levels[-1].sharding.is_equivalent_to(mesh.replicated, 2)
+
+    # scattered update: same dirty set, same roots, layout preserved
+    idx = np.array([0, 5, 63, 99], np.int32)
+    rows = rng.integers(0, 2 ** 32, (4, 8), dtype=np.uint32)
+    single.update(idx, rows.copy())
+    shard.update(idx, rows)
+    assert shard.root() == single.root()
+    assert shard.last_pairs_per_level == single.last_pairs_per_level
+    assert sum(shard.last_pairs_per_level) <= 2 * 4 * shard.depth
+    assert shard.levels[0].sharding.is_equivalent_to(mesh.shard_v, 2)
+
+    # append-grow: 100 -> 140 crosses the 128 pow2 (and, at 8 devices,
+    # the per-shard row boundary); the new capacity 256 rounds to a mesh
+    # multiple by construction
+    rows2 = rng.integers(0, 2 ** 32, (40, 8), dtype=np.uint32)
+    single.append(rows2.copy())
+    shard.append(rows2)
+    assert shard.root() == single.root()
+    assert shard.n == single.n == 140
+    assert shard.levels[0].shape == (256, 8)
+    assert shard.levels[0].sharding.is_equivalent_to(mesh.shard_v, 2)
+    assert shard.builds == single.builds == 1   # never a full rebuild
+
+
+def test_serving_mesh_epoch_padded_equals_single(serving_mesh):
+    """The serving layout's inert validator padding is bit-neutral: the
+    epoch program over [Vp]-padded sharded columns (V NOT divisible by the
+    mesh — the deposit-grown shape) returns the single-device outputs on
+    the [V] prefix, replicated scalars equal, and the padding rows stay
+    inert for the NEXT boundary too (chained call, zero re-layout)."""
+    import jax.numpy as jnp
+    from consensus_specs_tpu.models.phase0.epoch_soa import (
+        pad_epoch_inputs, pad_validator_columns)
+    from consensus_specs_tpu.parallel import trees_bitwise_equal
+
+    mesh = serving_mesh
+    spec = phase0.get_spec("minimal")
+    cfg = EpochConfig.from_spec(spec)
+    V = 64 * N_DEV + 3              # padding must cover 5 inert rows
+    cols, scal, inp = synthetic_epoch_state(
+        cfg, V, np.random.default_rng(17), random_eligibility=True,
+        random_slashed_balances=True)
+    vp = mesh.pad_rows(V)
+    cols_p = pad_validator_columns(cols, vp, cfg.FAR_FUTURE_EPOCH)
+    inp_p = pad_epoch_inputs(inp, vp)
+
+    single = epoch_transition_device(cfg, cols, scal, inp)
+    jax.block_until_ready(single)
+    sh_cols, sh_scal, sh_rep = mesh.epoch_transition(cfg, cols_p, scal, inp_p)
+    jax.block_until_ready(sh_cols)
+    trim = type(sh_cols)(*[x[:V] for x in sh_cols])
+    assert trees_bitwise_equal(single[0], trim)
+    assert trees_bitwise_equal(single[1], sh_scal)
+    assert trees_bitwise_equal(single[2], sh_rep)
+    # out_shardings matched in_shardings: outputs come back sharded and
+    # chain straight into the next boundary without re-layout
+    assert sh_cols.balance.sharding.is_equivalent_to(mesh.shard_v, 1)
+    next_scal = sh_scal._replace(
+        slot=sh_scal.slot + jnp.uint64(cfg.SLOTS_PER_EPOCH))
+    sh2_cols, _, _ = mesh.epoch_transition(cfg, sh_cols, next_scal, inp_p)
+    single2 = epoch_transition_device(
+        cfg, single[0], single[1]._replace(
+            slot=single[1].slot + jnp.uint64(cfg.SLOTS_PER_EPOCH)), inp)
+    assert trees_bitwise_equal(
+        single2[0], type(sh2_cols)(*[x[:V] for x in sh2_cols]))
+    assert sh2_cols.balance.sharding.is_equivalent_to(mesh.shard_v, 1)
+
+
+def test_serving_mesh_forest_leaf_builders_match_oracle(serving_mesh):
+    """registry_forest_leaves / balances_forest_chunks: inert padding rows
+    mask to the SSZ virtual-zero rows, real rows equal the single-device
+    builders, output placed per row_sharding — and the traced v_count
+    means a registry grown INSIDE the same padding reuses the program."""
+    import jax.numpy as jnp
+    from consensus_specs_tpu.utils.ssz import bulk
+
+    mesh = serving_mesh
+    rng = np.random.default_rng(29)
+    V, vp = 100, mesh.pad_rows(100)
+    pk = rng.integers(0, 256, (vp, 48), dtype=np.uint8)
+    wc = rng.integers(0, 256, (vp, 32), dtype=np.uint8)
+    epochs = [rng.integers(0, 50, vp).astype(np.uint64) for _ in range(4)]
+    slashed = rng.random(vp) < 0.1
+    eff = rng.integers(1, 2 ** 35, vp).astype(np.uint64)
+    bal = np.where(np.arange(vp) < V,
+                   rng.integers(1, 2 ** 35, vp), 0).astype(np.uint64)
+    args = [jax.device_put(jnp.asarray(a), mesh.shard_v)
+            for a in (pk, wc, *epochs, slashed, eff)]
+    leaves = mesh.registry_forest_leaves(*args, v_count=V)
+    assert leaves.shape == (128, 8)     # pow2 of the LOGICAL count
+    assert leaves.sharding.is_equivalent_to(mesh.shard_v, 2)
+    want = np.asarray(bulk.registry_leaf_words_device(
+        pk[:V], wc[:V], *[e[:V] for e in epochs], slashed[:V], eff[:V]))
+    got = np.asarray(leaves)
+    np.testing.assert_array_equal(got[:V], want)
+    assert not got[V:].any()            # virtual-zero padding rows
+
+    chunks = mesh.balances_forest_chunks(
+        jax.device_put(jnp.asarray(bal), mesh.shard_v), V)
+    want_c = np.asarray(bulk.balances_chunk_words_device(bal[:V]))
+    assert chunks.shape[0] == 32        # pow2 of ceil(100/4)
+    np.testing.assert_array_equal(np.asarray(chunks)[:want_c.shape[0]], want_c)
+    assert not np.asarray(chunks)[want_c.shape[0]:].any()
+
+
 def test_hierarchical_mesh_epoch_equals_single():
     """Multi-host shape: 8 virtual devices arranged as 2 hosts x 4 ICI
     devices (the DCN-outer/ICI-inner mesh of parallel/sharding.py). The

@@ -272,6 +272,129 @@ def test_checkpoint_resume_light_residency(spec):
     assert int(light.slot) == int(state.slot)
 
 
+@pytest.fixture
+def serving_mesh():
+    import jax
+    from consensus_specs_tpu.parallel.sharding import ServingMesh
+    if len(jax.devices()) < 8:
+        pytest.skip(f"needs 8 devices, have {len(jax.devices())}")
+    return ServingMesh.create(8)
+
+
+def test_resident_sharded_serving_loop(spec, serving_mesh):
+    """The whole serving loop under the validator-axis NamedSharding:
+    multi-slot chained steps across epoch boundaries with the columns and
+    forests never leaving the mesh layout, every per-transition root
+    bit-equal to the object model (which the single-device suite above
+    already gates bit-equal to the single-device core)."""
+    mesh = serving_mesh
+    state = factories.seed_genesis_state(spec, 4 * spec.SLOTS_PER_EPOCH)
+    factories.advance_slots(spec, state, 2)
+    ref, res = deepcopy(state), deepcopy(state)
+    core = ResidentCore(spec, res, mesh=mesh)
+    try:
+        assert core.cols.balance.sharding.is_equivalent_to(mesh.shard_v, 1)
+        _drive(spec, ref, res, core, 2 * spec.SLOTS_PER_EPOCH + 2)
+        assert spec.get_current_epoch(ref) >= 2
+        # chained boundaries kept the layout: columns still sharded, the
+        # forests' sharded levels still on their shards, cap replicated
+        assert core.cols.balance.sharding.is_equivalent_to(mesh.shard_v, 1)
+        assert core._reg_forest.levels[0].sharding.is_equivalent_to(
+            mesh.shard_v, 2)
+        assert core._reg_forest.levels[-1].sharding.is_equivalent_to(
+            mesh.replicated, 2)
+    finally:
+        core.exit()
+    assert serialize(ref, spec.BeaconState) == serialize(res, spec.BeaconState)
+
+
+def test_resident_sharded_fallback_and_deposit_growth(spec, serving_mesh):
+    """Under sharding, a registry-mutating block re-enters INCREMENTALLY
+    (same forests, scatter-only updates, no rebuild) and a deposit
+    append-grows the padded columns and forests across a shard boundary
+    (V 32 -> 33: columns 32 -> 40 rows, forest capacity 32 -> 64), all
+    bit-equal to the object model."""
+    from consensus_specs_tpu.utils.merkle import tree_depth
+
+    mesh = serving_mesh
+    state = factories.seed_genesis_state(spec, 4 * spec.SLOTS_PER_EPOCH)
+    factories.advance_slots(spec, state, 2)
+    ref, res = deepcopy(state), deepcopy(state)
+    core = ResidentCore(spec, res, mesh=mesh)
+    try:
+        core._state_root(res)
+        f_reg, f_bal = core._reg_forest, core._bal_forest
+        V = len(ref.validator_registry)
+        assert V % mesh.size == 0, "seed V must already tile the mesh"
+        assert f_reg.n == V and f_reg.builds == 1
+
+        # -- slashing: incremental re-entry, forests survive -----------------
+        with core.suspended():
+            block = factories.empty_block_next(spec, ref)
+            block.body.proposer_slashings.append(
+                factories.double_proposal(spec, ref))
+            spec.process_slots(ref, block.slot)
+            spec.process_block(ref, block)
+        core.state_transition(res, block)
+        assert core._reg_forest is f_reg and core._bal_forest is f_bal
+        assert f_reg.builds == 1
+        assert 0 < sum(f_reg.last_pairs_per_level) <= 2 * 2 * f_reg.depth
+        assert hash_tree_root(ref) == core._state_root(res)
+        assert core.cols.balance.sharding.is_equivalent_to(mesh.shard_v, 1)
+
+        # -- deposit: V -> V+1 crosses padding AND capacity ------------------
+        with core.suspended():
+            deposit = factories.stage_deposit(
+                spec, ref, V, spec.MAX_EFFECTIVE_BALANCE)
+            res.latest_eth1_data = deepcopy(ref.latest_eth1_data)
+            block = factories.empty_block_next(spec, ref)
+            block.body.deposits.append(deposit)
+            spec.process_slots(ref, block.slot)
+            spec.process_block(ref, block)
+        core.state_transition(res, block)
+        assert core._v == V + 1
+        # columns padded to the next mesh multiple with inert rows
+        assert int(core.cols.balance.shape[0]) == mesh.pad_rows(V + 1)
+        assert core.cols.balance.sharding.is_equivalent_to(mesh.shard_v, 1)
+        assert core._reg_forest is f_reg and f_reg.n == V + 1
+        assert f_reg.depth == tree_depth(V + 1) > tree_depth(V)
+        assert f_reg.builds == 1                  # grew, did not rebuild
+        assert len(core._pk_np) == V + 1
+        assert hash_tree_root(ref) == core._state_root(res)
+
+        # -- and the next epoch boundary still runs sharded ------------------
+        target = spec.get_epoch_start_slot(spec.get_current_epoch(ref) + 1)
+        with core.suspended():
+            spec.process_slots(ref, target)
+        core.process_slots(res, target)
+        assert hash_tree_root(ref) == core._state_root(res)
+        assert core.cols.balance.sharding.is_equivalent_to(mesh.shard_v, 1)
+    finally:
+        core.exit()
+    assert serialize(ref, spec.BeaconState) == serialize(res, spec.BeaconState)
+
+
+def test_resident_serving_mesh_env_knob(spec, serving_mesh, monkeypatch):
+    """CSTPU_SERVING_MESH turns the sharded serving path on without code
+    changes (the production entry); unset/0 keeps single-device."""
+    state = factories.seed_genesis_state(spec, 2 * spec.SLOTS_PER_EPOCH)
+    monkeypatch.setenv("CSTPU_SERVING_MESH", "8")
+    core = ResidentCore(spec, deepcopy(state))
+    try:
+        assert core._mesh is not None and core._mesh.size == 8
+        assert core.cols.balance.sharding.is_equivalent_to(
+            core._mesh.shard_v, 1)
+        assert core._state_root(core.state) == hash_tree_root(state)
+    finally:
+        core.exit()
+    monkeypatch.setenv("CSTPU_SERVING_MESH", "0")
+    core = ResidentCore(spec, deepcopy(state))
+    try:
+        assert core._mesh is None
+    finally:
+        core.exit()
+
+
 def test_from_checkpoint_rejects_phase1_hooks(spec):
     """A phase-1 spec (epoch insert hooks) must refuse BOTH entry points —
     the staged path (process_epoch_soa_staged) owns that configuration."""

@@ -95,3 +95,69 @@ def test_trees_bitwise_equal_mixed_host_device_leaves():
     a = {"x": np.arange(8, dtype=np.uint64)}
     b = {"x": jnp.arange(8, dtype=jnp.uint64)}
     assert trees_bitwise_equal(a, b)
+
+
+def test_shard_leading_axis_rejects_non_divisible_axis():
+    """A leading axis that does not tile the mesh must raise up front —
+    naming the axis size, the mesh size, and the pow2-pad helper — instead
+    of letting pjit pad (or reject) unpredictably per jax version."""
+    mesh = validator_mesh()
+    bad = {"cols": jnp.arange(33, dtype=jnp.uint32)}
+    with pytest.raises(ValueError) as exc:
+        shard_leading_axis(mesh, bad)
+    msg = str(exc.value)
+    assert "33" in msg and "8-device" in msg
+    assert "pad_leading_pow2" in msg and "64" in msg
+
+
+def test_pad_leading_pow2_makes_axis_shardable():
+    from consensus_specs_tpu.parallel.sharding import pad_leading_pow2
+    mesh = validator_mesh()
+    x = jnp.arange(33, dtype=jnp.uint32)
+    padded = pad_leading_pow2(x, mesh)
+    assert padded.shape == (64,)
+    assert (np.asarray(padded)[:33] == np.arange(33)).all()
+    assert not np.asarray(padded)[33:].any()
+    sharded = shard_leading_axis(mesh, padded)   # now accepted
+    assert sharded.sharding == NamedSharding(mesh, P("v"))
+    # already-divisible axes pass through untouched
+    y = jnp.arange(16, dtype=jnp.uint32)
+    assert pad_leading_pow2(y, mesh) is y
+
+
+def test_serving_mesh_from_env(monkeypatch):
+    from consensus_specs_tpu.parallel.sharding import ServingMesh
+    for off in ("", "0", "off"):
+        monkeypatch.setenv("CSTPU_SERVING_MESH", off)
+        assert ServingMesh.from_env() is None
+    monkeypatch.setenv("CSTPU_SERVING_MESH", "1")
+    assert ServingMesh.from_env() is None        # nothing to shard
+    monkeypatch.setenv("CSTPU_SERVING_MESH", "4")
+    m = ServingMesh.from_env()
+    assert m is not None and m.size == 4
+    monkeypatch.setenv("CSTPU_SERVING_MESH", "all")
+    # "all" rounds DOWN to a power of two (8 virtual devices here)
+    assert ServingMesh.from_env().size == 8
+    # explicit asks are refused with a clear message, never rounded
+    monkeypatch.setenv("CSTPU_SERVING_MESH", "6")
+    with pytest.raises(ValueError, match="power of two"):
+        ServingMesh.from_env()
+    monkeypatch.setenv("CSTPU_SERVING_MESH", "six")
+    with pytest.raises(ValueError, match="CSTPU_SERVING_MESH"):
+        ServingMesh.from_env()
+
+
+def test_serving_mesh_padding_and_row_sharding():
+    from consensus_specs_tpu.parallel.sharding import ServingMesh
+    mesh = ServingMesh.create(8)
+    assert mesh.pad_rows(0) == 0
+    assert mesh.pad_rows(1) == 8
+    assert mesh.pad_rows(32) == 32
+    assert mesh.pad_rows(33) == 40
+    # forest levels shard while their rows tile the mesh; the cap replicates
+    assert mesh.row_sharding(64) == mesh.shard_v
+    assert mesh.row_sharding(8) == mesh.shard_v
+    assert mesh.row_sharding(4) == mesh.replicated
+    assert mesh.row_sharding(1) == mesh.replicated
+    with pytest.raises(AssertionError):
+        ServingMesh.create(3)                    # mesh size must be pow2

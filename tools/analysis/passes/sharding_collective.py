@@ -53,6 +53,16 @@ register_rule(
     "a silent all-to-all reshard; make the producer's out spec the "
     "consumer's in spec (or constrain once at the boundary)",
 )
+register_rule(
+    "CSA605",
+    "jitted producer's out_shardings differ from the jitted consumer's "
+    "in_shardings",
+    "warning",
+    "chained jit programs (the serving loop's slot/epoch steps) re-lay "
+    "data out between every pair of calls whose shardings disagree; make "
+    "the producer's out_shardings the consumer's in_shardings "
+    "(SNIPPETS.md [1]: matched out/in axis resources in chained pjit)",
+)
 
 _COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
                 "all_to_all", "ppermute", "pshuffle", "psum_scatter",
@@ -258,4 +268,107 @@ def run(program: Program) -> List[Finding]:
                 for tgt in node.targets:
                     if isinstance(tgt, ast.Name):
                         spec_of[tgt.id] = key
+
+            # CSA605: chained jitted programs — a value produced by a jit
+            # with declared out_shardings feeding a jit whose in_shardings
+            # (at that argument position) disagree re-lays the data out
+            # between every pair of calls. Producer/consumer resolve the
+            # same single-target assigns as CSA604, so shardings named by
+            # constants compare equal to the same spec written inline.
+            def _jit_shardings(expr):
+                if not isinstance(expr, ast.Call) or \
+                        _dotted(expr.func).split(".")[-1] != "jit":
+                    return None
+                ins = outs = None
+                for kw in expr.keywords:
+                    if kw.arg == "in_shardings":
+                        ins = kw.value
+                    elif kw.arg == "out_shardings":
+                        outs = kw.value
+                return (ins, outs) if ins is not None or outs is not None \
+                    else None
+
+            jit_specs: Dict[str, tuple] = {}
+            for nm, expr in local_assigns.items():
+                got = _jit_shardings(expr)
+                if got is not None:
+                    jit_specs[nm] = got
+            if not jit_specs:
+                continue
+
+            def _resolve(e):
+                if isinstance(e, ast.Name):
+                    return local_assigns.get(e.id, e)
+                return e
+
+            def _in_elem(ins, i):
+                ins = _resolve(ins)
+                if isinstance(ins, ast.Tuple) and i < len(ins.elts):
+                    return _spec_key(_resolve(ins.elts[i]))
+                return _spec_key(ins)
+
+            # any rebinding of a name between producer and consumer (an
+            # explicit device_put re-layout, `y = y + 1`, ...) invalidates
+            # the recorded out-sharding — only a DIRECT producer->consumer
+            # chain is checked
+            rebinds: Dict[str, List[int]] = {}
+            for a in jitmap.own_nodes(fn):
+                if isinstance(a, ast.Assign):
+                    targets = list(a.targets)
+                elif isinstance(a, (ast.AugAssign, ast.AnnAssign, ast.For,
+                                    ast.AsyncFor, ast.NamedExpr)):
+                    targets = [a.target]
+                elif isinstance(a, (ast.With, ast.AsyncWith)):
+                    targets = [i.optional_vars for i in a.items
+                               if i.optional_vars is not None]
+                else:
+                    continue
+                for t in targets:
+                    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                    for e in elts:
+                        if isinstance(e, ast.Name):
+                            rebinds.setdefault(e.id, []).append(a.lineno)
+
+            def _stale(name: str, born: int, used: int) -> bool:
+                return any(born < ln < used for ln in rebinds.get(name, ()))
+
+            produced: Dict[str, tuple] = {}   # name -> (spec text, lineno)
+            calls = [c for c in jitmap.own_nodes(fn)
+                     if isinstance(c, ast.Call)
+                     and isinstance(c.func, ast.Name)
+                     and c.func.id in jit_specs]
+            for call in sorted(calls, key=lambda c: c.lineno):
+                ins, outs = jit_specs[call.func.id]
+                if ins is not None:
+                    for i, arg in enumerate(call.args):
+                        if isinstance(arg, ast.Name) and arg.id in produced:
+                            got, born = produced[arg.id]
+                            if _stale(arg.id, born, call.lineno):
+                                del produced[arg.id]
+                                continue
+                            want = _in_elem(ins, i)
+                            if want != got:
+                                findings.append(Finding(
+                                    "CSA605", info.path, call.lineno,
+                                    f"`{arg.id}` produced with "
+                                    f"out_shardings {got} feeds "
+                                    f"`{call.func.id}` whose in_shardings "
+                                    f"expect {want} (implicit per-call "
+                                    f"re-layout)",
+                                    context=info.qualname(fn)))
+                if outs is None:
+                    continue
+                par = parents.get(id(call))
+                if isinstance(par, ast.Assign) and len(par.targets) == 1:
+                    tgt = par.targets[0]
+                    outs_r = _resolve(outs)
+                    if isinstance(tgt, ast.Name):
+                        produced[tgt.id] = (_spec_key(outs_r), par.lineno)
+                    elif isinstance(tgt, ast.Tuple) and \
+                            isinstance(outs_r, ast.Tuple) and \
+                            len(tgt.elts) == len(outs_r.elts):
+                        for t, o in zip(tgt.elts, outs_r.elts):
+                            if isinstance(t, ast.Name):
+                                produced[t.id] = (_spec_key(_resolve(o)),
+                                                  par.lineno)
     return findings
