@@ -8,7 +8,7 @@ PYTHON ?= python
 VECTOR_DIR ?= out/vectors
 JUNIT ?= out/test-results.xml
 
-.PHONY: test testall citest citest-cov citest-mainnet lint analyze vectors vectors-minimal bench bench-cpu multichip smoke clean
+.PHONY: test testall citest citest-cov citest-mainnet lint analyze vectors vectors-minimal bench bench-cpu multichip telemetry smoke clean
 
 # measured 90.64% on the round-5 full suite; floor set just under so real
 # regressions fail while normal drift doesn't
@@ -49,12 +49,13 @@ lint:
 	$(PYTHON) tools/lint.py consensus_specs_tpu tests bench.py __graft_entry__.py tools
 
 # Trace-safety / spec-conformance static analysis (tools/analysis/README.md):
-# nine pass families over the call-graph IR — Python control flow on
+# ten pass families over the call-graph IR — Python control flow on
 # tracers, 32-bit truncation of uint64 math, impure traced code,
 # state-aliasing overrides, jit-cache hygiene, sharding/collective axis
 # consistency, pallas BlockSpec/grid/Ref contracts, spec drift vs the
-# reference pyspec (REFERENCE_ROOT, skips with a notice when absent), and
-# wide-column accumulation past the double-width laziness budget (CSA901).
+# reference pyspec (REFERENCE_ROOT, skips with a notice when absent),
+# wide-column accumulation past the double-width laziness budget (CSA901),
+# and unfenced perf_counter timing around jitted dispatch (CSA1001).
 # Exit 0 = no findings beyond the committed baseline + inline
 # `# csa: ignore[...]` suppressions. JSON artifact: out/analysis.json.
 REFERENCE_ROOT ?= /root/reference
@@ -85,6 +86,13 @@ bench-cpu:
 multichip:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+# Observability smoke: the resident serving loop with telemetry on —
+# dumps out/trace.json (Chrome trace), out/metrics.prom (Prometheus
+# exposition), out/telemetry.jsonl, and fails if the retrace/re-layout
+# watchdogs record any event on the steady-state drive (CI artifacts).
+telemetry:
+	$(PYTHON) tools/telemetry_smoke.py
+
 # Quick health check: lint + static analysis + the fast test modules.
 smoke:
 	$(PYTHON) tools/lint.py consensus_specs_tpu tests bench.py __graft_entry__.py tools
@@ -92,7 +100,7 @@ smoke:
 	$(PYTHON) -m tools.analysis consensus_specs_tpu bench.py __graft_entry__.py \
 		--baseline tools/analysis/baseline.json \
 		--reference-root $(REFERENCE_ROOT)
-	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py -q -m "not slow"
+	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py tests/test_telemetry.py -q -m "not slow"
 
 clean:
 	rm -rf out .pytest_cache $(VECTOR_DIR)

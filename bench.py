@@ -1059,6 +1059,119 @@ def bench_sharded_vs_single():
     return out
 
 
+def bench_telemetry():
+    """The telemetry acceptance row (ISSUE 8): (a) zero-overhead bound —
+    the epoch program timed with telemetry fully exercised (span + exit
+    fence + layout watchdog + counter) vs CSTPU_TELEMETRY=0, interleaved
+    min-of-5 per arm, <3%% asserted; (b) the watchdog gate — >= 4 chained
+    resident slot steps plus one epoch boundary under the validator-axis
+    serving mesh must report ZERO retrace and ZERO re-layout events (the
+    pjit layout-stability contract, checked at runtime). JSON keys:
+    epoch_{on,off}_ms, overhead_pct, watchdog.{devices, slot_steps,
+    boundaries, retrace_events, relayout_events, drive_ms}."""
+    import jax
+    from consensus_specs_tpu import telemetry
+    from consensus_specs_tpu.telemetry import watchdog as wd
+    from consensus_specs_tpu.models import phase0
+    from consensus_specs_tpu.models.phase0.epoch_soa import (
+        EpochConfig, epoch_transition_device, synthetic_epoch_state)
+
+    spec = phase0.get_spec("mainnet")
+    cfg = EpochConfig.from_spec(spec)
+    cols, scal, inp = synthetic_epoch_state(
+        cfg, V_DEVICE, np.random.default_rng(11))
+    out = epoch_transition_device(cfg, cols, scal, inp)   # warm compile
+    _sync(out)
+    cols = out[0]
+
+    def run_once(cols):
+        t0 = time.perf_counter()
+        with telemetry.span("bench.telemetry_probe") as sp:
+            out = epoch_transition_device(cfg, cols, scal, inp)
+            wd.layout_check("bench.telemetry_probe.cols", out[0])
+            telemetry.counter("bench.telemetry_probe.iters").inc()
+            sp.fence(out[0].balance)
+        _sync(out)      # both arms end fully fenced (off-arm span no-ops)
+        return time.perf_counter() - t0, out[0]
+
+    # main() pins telemetry on for the harness; restore that pin (not env
+    # control) after each arm-toggling section
+    prev_enabled = telemetry.core._enabled_override
+    times = {True: [], False: []}
+    try:
+        for _ in range(5):
+            for flag in (False, True):    # interleaved: drift lands evenly
+                telemetry.set_enabled(flag)
+                dt, cols = run_once(cols)
+                times[flag].append(dt)
+    finally:
+        telemetry.set_enabled(prev_enabled)
+    on_s, off_s = min(times[True]), min(times[False])
+    overhead_pct = max(0.0, (on_s - off_s) / off_s * 100.0)
+    row = {
+        "epoch_on_ms": round(on_s * 1e3, 2),
+        "epoch_off_ms": round(off_s * 1e3, 2),
+        "overhead_pct": round(overhead_pct, 2),
+        "validators": V_DEVICE,
+    }
+    if V_DEVICE >= 16384:
+        # the bound is meaningful once the epoch program amortizes the
+        # fixed ~0.5 ms fence round trip; at toy smoke shapes (an epoch of
+        # a few ms) the on-arm's one extra tiny fetch IS a few percent, so
+        # record without asserting there (committed captures run >= 65536)
+        assert overhead_pct < 3.0, \
+            f"telemetry overhead {overhead_pct:.2f}% >= 3% bound"
+    else:
+        row["overhead_asserted"] = False
+
+    n_dev = 1
+    while n_dev * 2 <= min(8, len(jax.devices())):
+        n_dev *= 2
+    if n_dev < 2:
+        row["watchdog"] = {"skipped": f"single-device backend "
+                                      f"({len(jax.devices())} device)"}
+        return row
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.models.phase0.resident import ResidentCore
+    from consensus_specs_tpu.parallel.sharding import ServingMesh
+    from consensus_specs_tpu.testing import factories
+    bls.bls_active = False
+    spec_min = phase0.get_spec("minimal")
+    spec_min.clear_caches()
+    state = factories.seed_genesis_state(
+        spec_min, 4 * spec_min.SLOTS_PER_EPOCH)
+    factories.advance_slots(spec_min, state, 2)
+    # pin telemetry ON for the drive: with CSTPU_TELEMETRY=0 in the env
+    # the watchdogs would no-op and a 0/0 row would be vacuous, not a
+    # verified acceptance result
+    telemetry.set_enabled(True)
+    core = ResidentCore(spec_min, state, mesh=ServingMesh.create(n_dev))
+    try:
+        spe = spec_min.SLOTS_PER_EPOCH
+        target = (state.slot // spe + 1) * spe + 1
+        core.process_slots(state, target)          # warm-up epoch
+        retrace0 = telemetry.counter("watchdog.retrace_events").value
+        relayout0 = telemetry.counter("watchdog.relayout_events").value
+        t0 = time.perf_counter()
+        core.process_slots(state, target + spe)    # >= 4 slots + 1 boundary
+        drive_s = time.perf_counter() - t0
+        retrace = telemetry.counter("watchdog.retrace_events").value - retrace0
+        relayout = (telemetry.counter("watchdog.relayout_events").value
+                    - relayout0)
+        assert retrace == 0 and relayout == 0, \
+            f"watchdog events on the steady resident loop: " \
+            f"retrace={retrace} relayout={relayout}"
+        row["watchdog"] = {
+            "devices": n_dev, "slot_steps": int(spe), "boundaries": 1,
+            "retrace_events": int(retrace), "relayout_events": int(relayout),
+            "drive_ms": round(drive_s * 1e3, 2),
+        }
+    finally:
+        core.exit()
+        telemetry.set_enabled(prev_enabled)
+    return row
+
+
 def main():
     _probe_backend()
     # virtual 8-device mesh for the sharded_vs_single stage on CPU runs
@@ -1101,12 +1214,23 @@ def main():
                       "failed to connect", "Connection reset")
     device_error = None
 
+    # every stage runs under a telemetry span (the snapshot embedded in
+    # the JSON row carries per-stage wall times), and the global compile
+    # listener cross-checks the per-key retrace watchdog. Telemetry is
+    # PINNED ON for the whole harness: the staged timings (s2s, resident)
+    # are span-derived now, and an ambient CSTPU_TELEMETRY=0 would
+    # silently zero them into a bogus-but-plausible capture.
+    from consensus_specs_tpu import telemetry
+    telemetry.set_enabled(True)
+    telemetry.watchdog.install_compile_listener()
+
     def _device(label, fn):
         nonlocal device_error
         if device_error is not None:
             return None
         try:
-            return fn()
+            with telemetry.span("bench." + label.replace(" ", "_")):
+                return fn()
         except (RuntimeError, OSError) as e:
             msg = f"{type(e).__name__}: {e}"
             if isinstance(e, RuntimeError) and not any(
@@ -1194,6 +1318,17 @@ def main():
                   "%(slot_update_single_ms).1f ms — bit-identical" % svs)
     elif svs is not None:
         _progress("sharded vs single skipped: %(skipped)s" % svs)
+    trow = _device("telemetry", bench_telemetry)
+    if trow is not None:
+        msg = ("telemetry overhead %(overhead_pct).2f%% (epoch on "
+               "%(epoch_on_ms).1f / off %(epoch_off_ms).1f ms)" % trow)
+        watch = trow.get("watchdog", {})
+        if "retrace_events" in watch:
+            msg += ("; watchdogs: %(retrace_events)d retrace / "
+                    "%(relayout_events)d re-layout events over "
+                    "%(slot_steps)d slots + %(boundaries)d boundary on the "
+                    "%(devices)d-device mesh" % watch)
+        _progress(msg)
     bls_res = _device("BLS batch", bench_bls_device)
     t_bls, t_py_verify = bls_res if bls_res is not None else (None, None)
     if t_bls is not None:
@@ -1254,6 +1389,13 @@ def main():
                 svs["epoch_single_ms"], svs["root_sharded_ms"],
                 svs["root_single_ms"], svs["slot_update_sharded_ms"],
                 svs["slot_update_single_ms"]))
+    if trow is not None:
+        txt = "telemetry overhead %.2f%% (<3%% asserted)" % \
+            trow["overhead_pct"]
+        if "retrace_events" in trow.get("watchdog", {}):
+            txt += (", watchdogs 0 retrace / 0 re-layout events over the "
+                    "%d-device resident drive" % trow["watchdog"]["devices"])
+        parts.append(txt)
     if t_bls is not None:
         parts.append("%d-agg-verify %.1f ms = %.0f aggverify/s/chip" % (
             N_ATTESTATIONS, t_bls * 1e3, N_ATTESTATIONS / t_bls))
@@ -1294,14 +1436,19 @@ def main():
         record["pairing_redc_ab"] = prab
     if svs is not None:
         record["sharded_vs_single"] = svs
+    if trow is not None:
+        record["telemetry_overhead"] = trow
     # provenance stamp on EVERY row (not just a top-level note): a
     # cpu_fallback artifact must be distinguishable from a real capture
     # without reading logs
     tag = _probe_tag()
     record["probe"] = tag
-    for row in (inc, ab, smab, prab, svs):
+    for row in (inc, ab, smab, prab, svs, trow):
         if isinstance(row, dict):
             row["probe"] = tag
+    # the full registry snapshot rides the artifact: per-stage span wall
+    # times, REDC/forest/scalar-mul counters, watchdog event totals
+    record["telemetry"] = telemetry.snapshot()
     print(json.dumps(record))
 
 

@@ -242,6 +242,23 @@ class BeaconNodeAPI:
             raise ApiError(400, "malformed attestation")
         self.published_attestations.append(attestation)
 
+    # -- /metrics -----------------------------------------------------------
+
+    def get_metrics(self) -> str:
+        """GET /metrics: the telemetry registry in Prometheus text
+        exposition format (spans, counters, watchdog events). Not part of
+        the 2019 oapi.yaml — the operational surface every production
+        beacon node grew; served even while syncing (a node you cannot
+        observe while it syncs is a node you cannot operate)."""
+        from .. import telemetry
+        return telemetry.prometheus_text()
+
+    def get_trace(self) -> dict:
+        """GET /trace: the span ring buffer as Chrome-trace JSON (load in
+        chrome://tracing / ui.perfetto.dev)."""
+        from .. import telemetry
+        return telemetry.chrome_trace()
+
     # -----------------------------------------------------------------------
 
     def _reject_if_syncing(self) -> None:

@@ -33,6 +33,7 @@ from typing import NamedTuple
 
 import numpy as np
 
+from ... import telemetry
 from ...ops import intmath  # enables jax_enable_x64 on import
 
 import jax  # noqa: E402
@@ -390,9 +391,15 @@ def epoch_transition_device(cfg: EpochConfig, cols: ValidatorColumns,
     executables never reproduced it in stress runs). The tests differential
     against the object model on CPU, so correctness there must not depend
     on cache temperature."""
-    fn = (_epoch_transition_undonated if jax.default_backend() == "cpu"
-          else _epoch_transition_donated)
-    return fn(cfg, cols, scal, inp)
+    return _epoch_transition_jit()(cfg, cols, scal, inp)
+
+
+def _epoch_transition_jit():
+    """The backend-selected jitted epoch program (donated off-CPU) — the
+    dispatch point the retrace watchdog wraps (resident.py passes it to
+    telemetry.watchdog.dispatch with a shape-pinned key)."""
+    return (_epoch_transition_undonated if jax.default_backend() == "cpu"
+            else _epoch_transition_donated)
 
 
 _stage_a_jit = partial(jax.jit, static_argnums=(0,))(_stage_a_traced)
@@ -917,73 +924,75 @@ def process_epoch_soa(spec, state, timings: dict = None):
 
     Returns the post-transition device columns (still device-resident) so
     production callers can chain the device state root without a re-upload.
-    When `timings` is given, per-stage wall-clock seconds are recorded into
-    it ("distill" host-only work, "perm" the device layout permutations,
-    "device", "writeback") with honest output-fetch fences (phase-1's
-    staged path below leaves `timings` untouched).
+    Stages run under telemetry spans ("epoch.distill", "epoch.perm",
+    "epoch.device", "epoch.writeback") with honest fences at span exit
+    only; when `timings` is given, the span durations are mirrored into it
+    under the historical keys ("distill", "perm", "device", "writeback")
+    so bench JSON stays comparable — zeros when CSTPU_TELEMETRY=0
+    (phase-1's staged path below leaves `timings` untouched).
     """
     if spec._insert_after_registry_updates or spec._insert_after_final_updates:
         # Phase-1 hooks splice between the two fused stages: run the device
         # program staged around them, preserving exact insert ordering.
         return process_epoch_soa_staged(spec, state)
 
-    import time as _time
-    t0 = _time.perf_counter()
-    cfg = EpochConfig.from_spec(spec)
-    np_cols = columns_np_from_state(state)
-    cols = columns_from_state(state, np_cols)
-    scal = scalars_from_state(state)
+    with telemetry.span("epoch.distill") as sp_cols:
+        cfg = EpochConfig.from_spec(spec)
+        np_cols = columns_np_from_state(state)
+        cols = columns_from_state(state, np_cols)
+        scal = scalars_from_state(state)
 
-    current_epoch = spec.get_current_epoch(state)
-    previous_epoch = spec.get_previous_epoch(state)
+        current_epoch = spec.get_current_epoch(state)
+        previous_epoch = spec.get_previous_epoch(state)
 
-    t_cols = _time.perf_counter() - t0
     if timings is not None:
         # The two layout permutations are DEVICE compute (the swap-or-not
         # kernel), not host distillation: warm them into the spec's perm
-        # cache under their own bucket so "distill" reports host-only work
-        # (a resident pipeline reuses the epoch's cached perms outright).
-        t0p = _time.perf_counter()
-        for e in (previous_epoch, current_epoch):
-            spec.get_shuffle_permutation(
-                _active_count_np(np_cols, e), spec.generate_seed(state, e))
-        timings["perm"] = _time.perf_counter() - t0p
-    t0 = _time.perf_counter()
+        # cache under their own span so "epoch.distill" reports host-only
+        # work (a resident pipeline reuses the epoch's cached perms).
+        with telemetry.span("epoch.perm") as sp_perm:
+            for e in (previous_epoch, current_epoch):
+                spec.get_shuffle_permutation(
+                    _active_count_np(np_cols, e), spec.generate_seed(state, e))
+        timings["perm"] = sp_perm.duration
 
-    # Crosslink record updates run on host (byte roots), before input
-    # distillation — same order as process_epoch (:1251-1262).
-    ctx = build_epoch_context(spec, state, np_cols)
-    process_crosslinks_vectorized(spec, state, ctx)
-    inp = build_epoch_inputs(spec, state, ctx)
+    with telemetry.span("epoch.distill") as sp_inp:
+        # Crosslink record updates run on host (byte roots), before input
+        # distillation — same order as process_epoch (:1251-1262).
+        ctx = build_epoch_context(spec, state, np_cols)
+        process_crosslinks_vectorized(spec, state, ctx)
+        inp = build_epoch_inputs(spec, state, ctx)
+        if timings is not None:
+            # fence the async uploads at span exit so transfer cost lands
+            # in "epoch.distill", not in the device-program span (tiny
+            # per-array fetches — the only fence the tunneled relay
+            # honors). Opt-in exactly as before: a caller that asked for
+            # no timings must not pay the per-leaf round trips.
+            sp_inp.fence(cols, scal, inp)
+
+    with telemetry.span("epoch.device") as sp_dev:
+        dev_cols, dev_scal, dev_report = epoch_transition_device(
+            cfg, cols, scal, inp)
+        sp_dev.fence(dev_cols.balance)
+
+    with telemetry.span("epoch.writeback") as sp_wb:
+        new_cols, new_scal, report = jax.device_get(
+            (dev_cols, dev_scal, dev_report))
+
+        _apply_justification(spec, state, new_scal, report,
+                             previous_epoch, current_epoch)
+        _apply_validator_columns(state, new_cols)
+        state.latest_slashed_balances = [
+            int(x) for x in np.asarray(new_scal.latest_slashed_balances)]
+        state.latest_start_shard = int(new_scal.latest_start_shard)
+
+        # Host-side final updates (:1526-1564), byte-rooted (shared helper)
+        spec.final_updates_byte_rooted(state)
+
     if timings is not None:
-        # fence the async uploads so transfer cost lands in "distill", not
-        # in the device-program bucket (tiny per-array fetches — the only
-        # fence the tunneled relay honors)
-        for leaf in jax.tree_util.tree_leaves((cols, scal, inp)):
-            np.asarray(leaf.ravel()[0:1])
-    t1 = _time.perf_counter()
-
-    dev_cols, dev_scal, dev_report = epoch_transition_device(cfg, cols, scal, inp)
-    # fence: materialize one output element (block_until_ready is not a
-    # reliable fence through the tunneled TPU relay)
-    np.asarray(dev_cols.balance[0:1])
-    t2 = _time.perf_counter()
-
-    new_cols, new_scal, report = jax.device_get((dev_cols, dev_scal, dev_report))
-
-    _apply_justification(spec, state, new_scal, report,
-                         previous_epoch, current_epoch)
-    _apply_validator_columns(state, new_cols)
-    state.latest_slashed_balances = [int(x) for x in np.asarray(new_scal.latest_slashed_balances)]
-    state.latest_start_shard = int(new_scal.latest_start_shard)
-
-    # Host-side final updates (:1526-1564), byte-rooted parts (shared helper)
-    spec.final_updates_byte_rooted(state)
-
-    if timings is not None:
-        timings["distill"] = t_cols + (t1 - t0)   # host-only (perm separate)
-        timings["device"] = t2 - t1
-        timings["writeback"] = _time.perf_counter() - t2
+        timings["distill"] = sp_cols.duration + sp_inp.duration
+        timings["device"] = sp_dev.duration
+        timings["writeback"] = sp_wb.duration
     return dev_cols, dev_scal
 
 

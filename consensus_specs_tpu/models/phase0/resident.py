@@ -50,12 +50,15 @@ serialized states and per-slot roots vs the object model.
 """
 from __future__ import annotations
 
+import itertools
 from typing import Dict, Optional
 
 import numpy as np
 
 import jax
 
+from ... import telemetry
+from ...telemetry import watchdog as _watchdog
 from ...utils.ssz import bulk
 from ...utils.ssz import impl as ssz_impl
 from ...utils.ssz.incremental import (IncrementalMerkleTree,
@@ -63,15 +66,20 @@ from ...utils.ssz.incremental import (IncrementalMerkleTree,
 from . import helpers as helpers_mod
 from .epoch_soa import (EpochConfig, ValidatorColumns, build_epoch_context,
                         build_epoch_inputs, columns_np_from_state,
-                        epoch_transition_device, inert_column_tail,
-                        pad_epoch_inputs, pad_validator_columns,
-                        process_crosslinks_vectorized, scalars_from_state,
-                        _apply_justification, _apply_validator_columns)
+                        inert_column_tail, pad_epoch_inputs,
+                        pad_validator_columns, process_crosslinks_vectorized,
+                        scalars_from_state, _apply_justification,
+                        _apply_validator_columns, _epoch_transition_jit)
 
 # Mirror columns the host-side spec logic reads between boundaries.
 _MIRROR_FIELDS = ("activation_epoch", "exit_epoch", "effective_balance",
                   "slashed")
 _ALL_FIELDS = ValidatorColumns._fields
+
+# Per-core watchdog key prefix: layout fingerprints must not leak between
+# cores (a mesh core and a single-device core in one test process would
+# otherwise trip false re-layout events against each other's placements).
+_CORE_SEQ = itertools.count()
 
 
 def light_state_from_bytes(spec, data: bytes):
@@ -144,6 +152,7 @@ class ResidentCore:
                 "resident mode covers the phase-0 fused epoch program; "
                 "phase-1 insert hooks take process_epoch_soa_staged")
         self._mesh = _serving_mesh(mesh)
+        self._tkey = f"resident{next(_CORE_SEQ)}"
         self.spec = spec
         self.cfg = EpochConfig.from_spec(spec)
         self.state = state
@@ -186,6 +195,7 @@ class ResidentCore:
         state = light_state_from_bytes(spec, state_bytes)
         core = cls.__new__(cls)
         core._mesh = _serving_mesh(mesh)
+        core._tkey = f"resident{next(_CORE_SEQ)}"
         core.spec = spec
         core.cfg = EpochConfig.from_spec(spec)
         core.timings = {}
@@ -585,6 +595,14 @@ class ResidentCore:
             if self._bal_forest is None:
                 self._bal_forest = IncrementalMerkleTree(
                     bulk.balances_chunk_words_device(c.balance))
+        # re-layout watchdog on the resident forests: per-slot root
+        # requests must keep every level-0 buffer's placement (a rebuild
+        # at the same capacity reproduces it; only a deposit crossing the
+        # padded power of two legitimately re-places — and is reported)
+        _watchdog.layout_check(f"{self._tkey}.forest.reg.l0",
+                               self._reg_forest.levels[0])
+        _watchdog.layout_check(f"{self._tkey}.forest.bal.l0",
+                               self._bal_forest.levels[0])
         self._big_roots = (
             ssz_impl.mix_in_length(self._reg_forest.root(), V),
             ssz_impl.mix_in_length(self._bal_forest.root(), V))
@@ -669,7 +687,8 @@ class ResidentCore:
 
     def _process_slot(self, state) -> None:
         spec = self.spec
-        root = self._state_root(state)
+        with telemetry.span("resident.slot_root"):
+            root = self._state_root(state)
         state.latest_state_roots[state.slot % spec.SLOTS_PER_HISTORICAL_ROOT] = root
         if state.latest_block_header.state_root == spec.ZERO_HASH:
             state.latest_block_header.state_root = root
@@ -677,68 +696,77 @@ class ResidentCore:
             spec.signing_root(state.latest_block_header)
 
     def process_epoch_resident(self, state) -> None:
-        """The boundary transition on resident columns. Per-stage seconds
-        land in self.timings: "stage" (host distillation off the mirrors),
-        "device" (epoch program on resident columns), "refresh" (mirror
-        download + root recompute + byte-rooted final updates)."""
-        import time as _time
+        """The boundary transition on resident columns, under telemetry
+        spans ("resident.stage" — host distillation off the mirrors,
+        "resident.device" — the epoch program on resident columns,
+        "resident.refresh" — mirror download + root recompute +
+        byte-rooted final updates). self.timings keeps the historical
+        {"stage", "device", "refresh"} view, now derived from the spans
+        (zeros under CSTPU_TELEMETRY=0). The retrace and re-layout
+        watchdogs cover the dispatch: the epoch program must neither
+        recompile nor change the columns' placement between chained
+        boundaries."""
         spec = self.spec
-        t0 = _time.perf_counter()
-        current_epoch = spec.get_current_epoch(state)
-        previous_epoch = spec.get_previous_epoch(state)
-        ctx = build_epoch_context(spec, state, dict(
-            self.mirrors,
-            activation_eligibility_epoch=None,  # unused by the context
-            withdrawable_epoch=None,
-            balance=None))
-        process_crosslinks_vectorized(spec, state, ctx)
-        inp = build_epoch_inputs(spec, state, ctx)
-        scal = scalars_from_state(state)
-        if self._mesh is not None:
-            # pad the [V] facts to the columns' padded row count; the
-            # epoch jit's in_shardings place them on the mesh
-            inp = pad_epoch_inputs(inp, int(self.cols.balance.shape[0]))
-        for leaf in jax.tree_util.tree_leaves((scal, inp)):
-            np.asarray(leaf.ravel()[0:1])   # fence uploads into "stage"
-        t1 = _time.perf_counter()
+        with telemetry.span("resident.stage") as sp_stage:
+            current_epoch = spec.get_current_epoch(state)
+            previous_epoch = spec.get_previous_epoch(state)
+            ctx = build_epoch_context(spec, state, dict(
+                self.mirrors,
+                activation_eligibility_epoch=None,  # unused by the context
+                withdrawable_epoch=None,
+                balance=None))
+            process_crosslinks_vectorized(spec, state, ctx)
+            inp = build_epoch_inputs(spec, state, ctx)
+            scal = scalars_from_state(state)
+            if self._mesh is not None:
+                # pad the [V] facts to the columns' padded row count; the
+                # epoch jit's in_shardings place them on the mesh
+                inp = pad_epoch_inputs(inp, int(self.cols.balance.shape[0]))
+            sp_stage.fence(scal, inp)   # uploads land in "resident.stage"
 
-        if self._mesh is not None:
-            # matched in/out shardings: this boundary's output columns are
-            # the next boundary's inputs with ZERO re-layout between them
-            dev_cols, dev_scal, dev_report = self._mesh.epoch_transition(
-                self.cfg, self.cols, scal, inp)
-        else:
-            dev_cols, dev_scal, dev_report = epoch_transition_device(
-                self.cfg, self.cols, scal, inp)
-        np.asarray(dev_cols.balance[0:1])   # output fence
-        t2 = _time.perf_counter()
+        with telemetry.span("resident.device") as sp_dev:
+            # ONE layout key for the chained columns: input and output
+            # fingerprints must match across boundaries (any in->out or
+            # out->next-in placement change is a re-layout event)
+            _watchdog.layout_check(f"{self._tkey}.epoch.cols", self.cols)
+            if self._mesh is not None:
+                # matched in/out shardings: this boundary's output columns
+                # are the next boundary's inputs with ZERO re-layout
+                dev_cols, dev_scal, dev_report = self._mesh.epoch_transition(
+                    self.cfg, self.cols, scal, inp)
+            else:
+                dev_cols, dev_scal, dev_report = _watchdog.dispatch(
+                    (self._tkey, "epoch", int(self.cols.balance.shape[0])),
+                    _epoch_transition_jit(), self.cfg, self.cols, scal, inp)
+            _watchdog.layout_check(f"{self._tkey}.epoch.cols", dev_cols)
+            sp_dev.fence(dev_cols.balance)
 
-        self.cols = dev_cols
-        self._big_roots = None
-        # the boundary dirties every leaf (rewards touch all balances):
-        # degenerate to a full forest rebuild — exactly today's cost floor
-        self._reg_forest = None
-        self._bal_forest = None
-        self._active_idx_memo.clear()
-        new_scal, report = jax.device_get((dev_scal, dev_report))
-        _apply_justification(spec, state, new_scal, report,
-                             previous_epoch, current_epoch)
-        state.latest_slashed_balances = [
-            int(x) for x in np.asarray(new_scal.latest_slashed_balances)]
-        state.latest_start_shard = int(new_scal.latest_start_shard)
-        # refresh ONLY the columns host logic reads; slashed never changes
-        # in the epoch program, balances stay device-only (the [:_v] slice
-        # drops the sharded layout's inert padding rows)
-        for f in ("activation_epoch", "exit_epoch", "effective_balance"):
-            self.mirrors[f] = np.asarray(
-                jax.device_get(getattr(dev_cols, f)))[:self._v]
-        spec.final_updates_byte_rooted(state)   # the resident override
-        # prune attestation-root memo entries the rotation dropped
-        live = {id(a) for a in state.previous_epoch_attestations}
-        live.update(id(a) for a in state.current_epoch_attestations)
-        self._att_root_memo = {k: v for k, v in self._att_root_memo.items()
-                               if k in live}
-        self._registry_balances_roots()          # recompute + cache the roots
-        t3 = _time.perf_counter()
-        self.timings = {"stage": t1 - t0, "device": t2 - t1,
-                        "refresh": t3 - t2}
+        with telemetry.span("resident.refresh") as sp_ref:
+            self.cols = dev_cols
+            self._big_roots = None
+            # the boundary dirties every leaf (rewards touch all balances):
+            # degenerate to a full forest rebuild — today's cost floor
+            self._reg_forest = None
+            self._bal_forest = None
+            self._active_idx_memo.clear()
+            new_scal, report = jax.device_get((dev_scal, dev_report))
+            _apply_justification(spec, state, new_scal, report,
+                                 previous_epoch, current_epoch)
+            state.latest_slashed_balances = [
+                int(x) for x in np.asarray(new_scal.latest_slashed_balances)]
+            state.latest_start_shard = int(new_scal.latest_start_shard)
+            # refresh ONLY the columns host logic reads; slashed never
+            # changes in the epoch program, balances stay device-only (the
+            # [:_v] slice drops the sharded layout's inert padding rows)
+            for f in ("activation_epoch", "exit_epoch", "effective_balance"):
+                self.mirrors[f] = np.asarray(
+                    jax.device_get(getattr(dev_cols, f)))[:self._v]
+            spec.final_updates_byte_rooted(state)   # the resident override
+            # prune attestation-root memo entries the rotation dropped
+            live = {id(a) for a in state.previous_epoch_attestations}
+            live.update(id(a) for a in state.current_epoch_attestations)
+            self._att_root_memo = {k: v for k, v in self._att_root_memo.items()
+                                   if k in live}
+            self._registry_balances_roots()      # recompute + cache the roots
+        self.timings = {"stage": sp_stage.duration, "device": sp_dev.duration,
+                        "refresh": sp_ref.duration}

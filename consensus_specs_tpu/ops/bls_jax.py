@@ -57,6 +57,8 @@ from types import SimpleNamespace
 import numpy as np
 
 from ..crypto import bls12_381 as gt
+from ..telemetry import gauge as _tele_gauge
+from ..telemetry import watchdog as _watchdog
 from . import decompress as decomp
 from . import fq as F
 from . import fq_tower as T
@@ -330,7 +332,12 @@ def _redc_mode_jit(fn):
                     return fn(*a)
 
             progs[mode] = prog = jax.jit(pinned)
-        return prog(*args)
+        # retrace watchdog: key pins backend mode + input shapes, so the
+        # only legitimate compile per key is the first one (a later miss
+        # means the SAME pairing program retraced — dtype/weak-type drift)
+        key = (("bls", fn.__name__, mode)
+               + tuple(getattr(a, "shape", ()) for a in args))
+        return _watchdog.dispatch(key, prog, *args)
 
     return call
 
@@ -508,12 +515,20 @@ def _scalar_mul_dispatch(win_jit, da_jit, aff_x, aff_y, k: int, nbits: int):
     """One backend dispatch (CSTPU_SCALAR_MUL) shared by G1 and G2: recode
     on host (memoized exact int arithmetic), ship the digits as tiny traced
     arrays — the jit cache keys only on (batch shape, m, w)."""
-    if SM.scalar_mul_backend_name() == "window":
+    backend = SM.scalar_mul_backend_name()
+    if backend == "window":
         w = SM.scalar_mul_window()
+        # registry view of the dependent-add chain this dispatch buys
+        # (ops/scalar_mul.py's critical-path currency; double_add's is
+        # just nbits). Gauged here, not inside the traced program.
+        _tele_gauge("scalar_mul.seq_adds").set(
+            SM.sequential_adds(backend, nbits, w))
         rec = SM.recode_signed_windows(int(k), nbits, w)
         return win_jit(aff_x, aff_y, jnp.asarray(rec.idx),
                        jnp.asarray(rec.sign),
                        jnp.asarray(np.bool_(rec.correction)), w=w)
+    _tele_gauge("scalar_mul.seq_adds").set(
+        SM.sequential_adds(backend, nbits))
     return da_jit(aff_x, aff_y, jnp.asarray(SM.scalar_bits(int(k), nbits)))
 
 

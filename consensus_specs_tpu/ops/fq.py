@@ -76,6 +76,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..telemetry import counter as _tele_counter
 from . import intmath  # noqa: F401  (enables jax_enable_x64 before jnp use)
 
 import jax  # noqa: E402
@@ -195,18 +196,23 @@ def pinned_fq_redc_backend(name: str):
 # Trace-time REDC accounting: every fq_redc call (fq_mul included) adds its
 # static lane count — prod(batch shape) of the stacked reduction — so
 # tracing a program with the counters reset yields its traced-graph REDC
-# instance/lane totals (loop bodies count once). bench.py's pairing_redc_ab
-# row and tests/test_fq_redc.py's jaxpr cross-check read these.
-_REDC_TRACE = {"instances": 0, "lanes": 0}
+# instance/lane totals (loop bodies count once). The counts live in the
+# telemetry metrics registry (`fq.redc.instances` / `fq.redc.lanes`,
+# `always=True`: trace-time accounting that tests assert regardless of the
+# CSTPU_TELEMETRY switch); reset_redc_trace_stats/redc_trace_stats stay as
+# thin shims for bench.py's pairing_redc_ab row and tests/test_fq_redc.py.
+_REDC_INSTANCES = _tele_counter("fq.redc.instances", always=True)
+_REDC_LANES = _tele_counter("fq.redc.lanes", always=True)
 
 
 def reset_redc_trace_stats() -> None:
-    _REDC_TRACE["instances"] = 0
-    _REDC_TRACE["lanes"] = 0
+    _REDC_INSTANCES.reset()
+    _REDC_LANES.reset()
 
 
 def redc_trace_stats() -> dict:
-    return dict(_REDC_TRACE)
+    return {"instances": int(_REDC_INSTANCES.value),
+            "lanes": int(_REDC_LANES.value)}
 
 
 # ---------------------------------------------------------------------------
@@ -353,8 +359,8 @@ def fq_redc(cols):
     lanes = 1
     for d in shape[:-1]:
         lanes *= int(d)
-    _REDC_TRACE["instances"] += 1
-    _REDC_TRACE["lanes"] += lanes
+    _REDC_INSTANCES.inc()
+    _REDC_LANES.inc(lanes)
     carry = jnp.zeros(shape[:-1], dtype=jnp.int64)
     qinv = jnp.int64(QINV_NEG)
     mask = jnp.int64(MASK)

@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.phase0.epoch_soa import (
     EpochInputs, EpochReport, EpochScalars, ValidatorColumns,
     _epoch_transition_traced)
+from ..telemetry import watchdog as _watchdog
 from ..utils.merkle import next_power_of_two
 
 
@@ -272,7 +273,12 @@ class ServingMesh:
                 out_shardings=(cols_sh, scal_sh, report_sh),
                 donate_argnums=(0,) if donate else ())
             self._jits[key] = fn
-        return fn(cols, scal, inp)
+        # retrace watchdog: the key pins the full static context (mesh
+        # size, padded V, config), so any compile-cache miss after the
+        # first compile is a genuine retrace of the steady-state program
+        wkey = ("mesh.epoch", self.size, int(cols.balance.shape[0]),
+                cfg, donate)
+        return _watchdog.dispatch(wkey, fn, cols, scal, inp)
 
     # -- forest level-0 builders --------------------------------------------
 
@@ -308,10 +314,12 @@ class ServingMesh:
                 in_shardings=tuple([self.shard_v] * 8) + (self.replicated,),
                 out_shardings=self.row_sharding(p2))
             self._jits[key] = fn
-        return fn(pubkeys, withdrawal_credentials,
-                  activation_eligibility_epoch, activation_epoch,
-                  exit_epoch, withdrawable_epoch, slashed,
-                  effective_balance, np.int32(v_count))
+        return _watchdog.dispatch(
+            ("mesh.regleaves", self.size, vp, p2), fn,
+            pubkeys, withdrawal_credentials,
+            activation_eligibility_epoch, activation_epoch,
+            exit_epoch, withdrawable_epoch, slashed,
+            effective_balance, np.int32(v_count))
 
     def balances_forest_chunks(self, balances, v_count: int):
         """[P2c, 8] sharded level-0 rows of the balances forest from the
@@ -338,7 +346,8 @@ class ServingMesh:
             fn = jax.jit(traced, in_shardings=(self.shard_v,),
                          out_shardings=self.row_sharding(p2))
             self._jits[key] = fn
-        return fn(balances)
+        return _watchdog.dispatch(("mesh.balchunks", self.size, vp, p2),
+                                  fn, balances)
 
     def forest_build_jit(self, capacity: int):
         """One traced program building EVERY level of a pow2 `capacity`-leaf
@@ -358,7 +367,8 @@ class ServingMesh:
                          in_shardings=(self.row_sharding(capacity),),
                          out_shardings=out_sh)
             self._jits[key] = fn
-        return fn
+        wkey = ("mesh.forest_build", self.size, capacity)
+        return lambda leaves, _fn=fn: _watchdog.dispatch(wkey, _fn, leaves)
 
 
 def trees_bitwise_equal(a, b) -> bool:

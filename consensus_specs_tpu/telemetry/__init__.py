@@ -1,0 +1,43 @@
+"""Telemetry: spans, metrics registry, runtime watchdogs, export.
+
+The one coherent observability layer for the serving loop (ISSUE 8):
+
+    from consensus_specs_tpu import telemetry
+
+    with telemetry.span("epoch.device") as sp:
+        out = program(args)
+        sp.fence(out)                       # materialized at exit only
+    telemetry.counter("fq.redc.lanes").inc(n)
+    telemetry.snapshot()                    # dict for bench JSON rows
+    telemetry.prometheus_text()             # BeaconNodeAPI.get_metrics()
+    telemetry.watchdog.dispatch(key, fn, *args)   # retrace watchdog
+    telemetry.watchdog.layout_check(key, tree)    # re-layout watchdog
+
+Env knobs: CSTPU_TELEMETRY (default on; 0 = every span/metric a no-op),
+CSTPU_TELEMETRY_FENCE (default on; 0 = spans never fence at exit),
+CSTPU_TELEMETRY_RING (span ring-buffer size, default 4096).
+
+Naming scheme (dot-separated `subsystem.stage`): spans `epoch.*`
+(process_epoch_soa stages), `resident.*` (the resident serving loop),
+`bench.*` / `followup.*` (harnesses); counters `fq.redc.*` (trace-time
+REDC accounting), `merkle.forest.*` (pair-hash lanes/launches/builds),
+`scalar_mul.*`, `watchdog.*` (retrace/re-layout events),
+`jax.backend_compiles` (global compile listener).
+"""
+from .core import (Counter, Gauge, Histogram, Span, counter, current_span,
+                   enabled, fencing, gauge, histogram, instrument, reset,
+                   ring, set_enabled, set_fencing, snapshot, span,
+                   span_seconds)
+from .export import (chrome_trace, dump_chrome_trace, dump_prometheus,
+                     prometheus_text, write_jsonl)
+from . import watchdog
+from .watchdog import TelemetryWarning
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Span", "TelemetryWarning",
+    "chrome_trace", "counter", "current_span", "dump_chrome_trace",
+    "dump_prometheus", "enabled", "fencing", "gauge", "histogram",
+    "instrument", "prometheus_text", "reset", "ring", "set_enabled",
+    "set_fencing", "snapshot", "span", "span_seconds", "watchdog",
+    "write_jsonl",
+]

@@ -46,6 +46,14 @@ from ..merkle import next_power_of_two, tree_depth
 from ...ops.sha256 import (_unroll_for, bytes_to_words, merkle_pair_backend_name,
                            pair_hash_words, sha256_pairs_inner, words_to_bytes,
                            zerohash_words)
+from ...telemetry import counter as _tele_counter
+
+# Process-wide forest accounting in the telemetry registry; the
+# per-instance attributes (`last_pairs_per_level`, `total_pairs_hashed`,
+# `builds`) stay as the per-tree view tests and benches assert on.
+_PAIR_LANES = _tele_counter("merkle.forest.pair_lanes")
+_PAIR_LAUNCHES = _tele_counter("merkle.forest.launches")
+_FOREST_BUILDS = _tele_counter("merkle.forest.builds")
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -153,11 +161,14 @@ class IncrementalMerkleTree:
             self.last_pairs_per_level.append(0)
         self.last_pairs_per_level[depth] += lanes
         self.total_pairs_hashed += lanes
+        _PAIR_LANES.inc(lanes)
+        _PAIR_LAUNCHES.inc()
 
     # -- full build (the epoch-boundary degenerate case) --------------------
 
     def _build(self) -> None:
         self.builds += 1
+        _FOREST_BUILDS.inc()
         self.last_pairs_per_level = []
         level = self.levels[0]
         del self.levels[1:]
@@ -318,6 +329,7 @@ class ShardedIncrementalMerkleTree(IncrementalMerkleTree):
 
     def _build(self) -> None:
         self.builds += 1
+        _FOREST_BUILDS.inc()
         self.last_pairs_per_level = []
         level = self.levels[0]
         del self.levels[1:]

@@ -793,6 +793,99 @@ def test_wide_accumulation_suppression(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# CSA1001 honest timing (perf_counter around async dispatch with no fence)
+# ---------------------------------------------------------------------------
+
+_JIT_PREAMBLE = (
+    "import jax, time\n"
+    "import numpy as np\n"
+    "def f(x):\n"
+    "    return x\n"
+    "f_jit = jax.jit(f)\n"
+)
+
+
+def test_honest_timing_flags_unfenced_delta(tmp_path):
+    src = _JIT_PREAMBLE + (
+        "def bench(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = f_jit(x)\n"
+        "    dt = time.perf_counter() - t0\n"
+        "    return y, dt\n"
+    )
+    found = findings_for(tmp_path, src)
+    assert rule_ids(found) == ["CSA1001"]
+    assert found[0].context == "bench"
+
+
+def test_honest_timing_flags_chained_bucket_style(tmp_path):
+    # the t0/t1/t2 style epoch_soa used to hand-roll: the next
+    # perf_counter assignment closes the open region
+    src = _JIT_PREAMBLE + (
+        "def bench(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = f_jit(x)\n"
+        "    t1 = time.perf_counter()\n"
+        "    return y, t1 - t0\n"
+    )
+    assert rule_ids(findings_for(tmp_path, src)) == ["CSA1001"]
+
+
+def test_honest_timing_negative_fenced(tmp_path):
+    # every repo fence idiom clears the region, including inside the
+    # timed loop body
+    for fence in ("jax.block_until_ready(y)",
+                  "np.asarray(y.ravel()[0:1])",
+                  "y = y.tolist()"):
+        src = _JIT_PREAMBLE + (
+            "def bench(x):\n"
+            "    t0 = time.perf_counter()\n"
+            "    y = f_jit(x)\n"
+            f"    {fence}\n"
+            "    dt = time.perf_counter() - t0\n"
+            "    return dt\n"
+        )
+        assert findings_for(tmp_path, src) == [], fence
+    src = _JIT_PREAMBLE + (
+        "def _sync(o):\n"
+        "    return np.asarray(o)\n"
+        "def bench(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    for _ in range(3):\n"
+        "        _sync(f_jit(x))\n"
+        "    return time.perf_counter() - t0\n"
+    )
+    assert findings_for(tmp_path, src) == []
+
+
+def test_honest_timing_negative_no_dispatch(tmp_path):
+    # a plain host computation between the reads is not a finding
+    src = _JIT_PREAMBLE + (
+        "def bench(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = x + 1\n"
+        "    return time.perf_counter() - t0\n"
+    )
+    assert findings_for(tmp_path, src) == []
+
+
+def test_honest_timing_suppression(tmp_path):
+    src = _JIT_PREAMBLE + (
+        "def bench(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = f_jit(x)\n"
+        "    # csa: ignore[CSA1001] -- dispatch-only timing on purpose\n"
+        "    dt = time.perf_counter() - t0\n"
+        "    return y, dt\n"
+    )
+    path = tmp_path / "s.py"
+    path.write_text(src)
+    report = analyze_paths([str(path)])
+    assert report.findings == []
+    assert [f.rule for f in report.suppressed] == ["CSA1001"]
+
+
+# ---------------------------------------------------------------------------
 # CSA8xx spec drift (differential vs a reference tree)
 # ---------------------------------------------------------------------------
 
@@ -1049,6 +1142,12 @@ def test_cli_exit_codes_and_json(tmp_path):
     ("CSA901", "def f(a, b, c):\n"
                "    return (fq_mul_wide(a, b) + fq_mul_wide(a, c)\n"
                "            + fq_mul_wide(b, c))\n"),
+    ("CSA1001", "import jax, time\ndef f(x):\n    return x\n"
+                "f_jit = jax.jit(f)\n"
+                "def bench(x):\n"
+                "    t0 = time.perf_counter()\n"
+                "    y = f_jit(x)\n"
+                "    return time.perf_counter() - t0\n"),
 ])
 def test_cli_nonzero_per_rule_class(tmp_path, rule_class, snippet):
     """Acceptance: injected fixtures for each per-module rule class exit

@@ -1,0 +1,374 @@
+"""Telemetry subsystem gate (consensus_specs_tpu/telemetry/):
+
+  - span nesting, exit-only fencing, decorator form, ring buffer;
+  - metrics registry (counters/gauges/pow2-bucket histograms), the
+    `always=True` trace-time accounting path (fq REDC shims);
+  - Prometheus text exposition validity and Chrome-trace JSON schema;
+  - the retrace watchdog fires on a deliberately shape-polymorphic loop
+    and stays SILENT (zero events, zero re-layouts) across chained
+    resident slot steps + an epoch boundary on the 8-device mesh — the
+    runtime pjit layout-stability contract (ISSUE 8 acceptance);
+  - no-op mode (CSTPU_TELEMETRY=0) overhead bound.
+"""
+import json
+import re
+import time
+from copy import deepcopy
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from consensus_specs_tpu import telemetry as T
+from consensus_specs_tpu.telemetry import watchdog as W
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.models import phase0
+from consensus_specs_tpu.testing import factories
+
+
+@pytest.fixture(autouse=True)
+def tele():
+    """Pinned-on telemetry with a clean registry per test; restores env
+    control (and fencing) afterwards. Watchdog warm-up state is NOT
+    cleared globally — tests use fresh keys or explicit W.reset()."""
+    T.set_enabled(True)
+    T.reset()
+    yield
+    T.set_enabled(None)
+    T.set_fencing(None)
+
+
+@pytest.fixture
+def spec():
+    s = phase0.get_spec("minimal")
+    bls.bls_active = False
+    s.clear_caches()
+    yield s
+    s.clear_caches()
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_ring_and_aggregates():
+    with T.span("outer") as outer:
+        with T.span("inner", tag="x") as inner:
+            time.sleep(0.003)
+    assert outer.duration >= inner.duration > 0
+    records = T.ring()
+    assert [r["name"] for r in records] == ["inner", "outer"]  # close order
+    assert records[0]["parent"] == "outer" and records[0]["depth"] == 1
+    assert records[1]["parent"] == "" and records[1]["depth"] == 0
+    assert records[0]["args"] == {"tag": "x"}
+    snap = T.snapshot()["spans"]
+    assert snap["outer"]["count"] == 1
+    assert snap["inner"]["last_ms"] == snap["inner"]["total_ms"] > 0
+    assert T.span_seconds("inner") == inner.duration
+
+
+def test_instrument_decorator_respects_runtime_toggle():
+    @T.instrument("deco.fn")
+    def double(a):
+        return a * 2
+
+    assert double(3) == 6
+    assert T.snapshot()["spans"]["deco.fn"]["count"] == 1
+    T.set_enabled(False)
+    assert double(4) == 8          # still runs, nothing recorded
+    T.set_enabled(True)
+    assert T.snapshot()["spans"]["deco.fn"]["count"] == 1
+
+
+class _FakeLeaf:
+    """Duck-typed device array: records when its bytes were fetched."""
+
+    def __init__(self):
+        self.fetched_at = []
+
+    def ravel(self):
+        self.fetched_at.append(time.perf_counter())
+        return np.zeros(4)
+
+
+def test_span_fences_at_exit_only():
+    leaf = _FakeLeaf()
+    with T.span("fenced") as sp:
+        sp.fence((leaf,))          # nested pytree form
+        body_done = time.perf_counter()
+    assert len(leaf.fetched_at) == 1
+    assert leaf.fetched_at[0] >= body_done     # after the body, at exit
+    assert sp.duration >= leaf.fetched_at[0] - sp.t0  # fence inside the span
+
+    T.set_fencing(False)           # CSTPU_TELEMETRY_FENCE=0 equivalent
+    silent = _FakeLeaf()
+    with T.span("unfenced") as sp2:
+        sp2.fence(silent)
+    assert silent.fetched_at == []
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_registry_identity_and_noop_gating():
+    c = T.counter("t.ctr")
+    assert c is T.counter("t.ctr")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    T.gauge("t.g").set(2.5)
+    assert T.snapshot()["gauges"]["t.g"] == 2.5
+
+    T.set_enabled(False)
+    c.inc(100)
+    T.gauge("t.g").set(9.0)
+    assert c.value == 5 and T.gauge("t.g").value == 2.5
+    always = T.counter("t.always", always=True)
+    always.inc(2)
+    assert always.value == 2       # trace-time accounting ignores the switch
+
+
+def test_histogram_pow2_buckets():
+    h = T.histogram("t.h")
+    for v in (0.25, 0.3, 1.0, 1.5, 2.0, 5.0, 0.0, -3):
+        h.observe(v)
+    snap = T.snapshot()["histograms"]["t.h"]
+    assert snap["count"] == 8
+    assert snap["buckets"] == {"0": 2, "0.25": 1, "0.5": 1, "1": 1,
+                               "2": 2, "8": 1}
+    assert snap["sum"] == pytest.approx(0.25 + 0.3 + 1.0 + 1.5 + 2.0 + 5.0
+                                        + 0.0 - 3)
+
+
+def test_redc_shims_ride_the_registry_even_when_off():
+    from consensus_specs_tpu.ops import fq as F
+    T.set_enabled(False)           # lane assertions must survive opt-out
+    F.reset_redc_trace_stats()
+    jax.make_jaxpr(lambda a, b: F.fq_mul(a, b))(
+        jnp.zeros((2, F.L), jnp.int64), jnp.zeros((2, F.L), jnp.int64))
+    stats = F.redc_trace_stats()
+    assert stats["instances"] == 1 and stats["lanes"] == 2
+    assert T.counter("fq.redc.lanes").value == 2
+
+
+def test_forest_pair_lane_counters():
+    from consensus_specs_tpu.utils.ssz.incremental import IncrementalMerkleTree
+    rng = np.random.default_rng(0)
+    leaves = rng.integers(0, 2 ** 32, (16, 8), dtype=np.uint32)
+    base = T.counter("merkle.forest.pair_lanes").value
+    tree = IncrementalMerkleTree(leaves)
+    lanes = T.counter("merkle.forest.pair_lanes").value - base
+    assert lanes == sum(tree.last_pairs_per_level) == 8 + 4 + 2 + 1
+    assert T.counter("merkle.forest.builds").value >= 1
+
+
+# ---------------------------------------------------------------------------
+# export surfaces
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+-]+$|"
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*\{le=\"\+Inf\"\} [0-9]+$")
+
+
+def test_prometheus_exposition_is_valid():
+    T.counter("p.ctr").inc(7)
+    T.gauge("p.g").set(1.25)
+    h = T.histogram("p.h")
+    for v in (0.3, 1.0, 9.0):
+        h.observe(v)
+    with T.span("p.span"):
+        pass
+    text = T.prometheus_text()
+    lines = text.strip().splitlines()
+    families = set()
+    for line in lines:
+        if line.startswith("# TYPE "):
+            _, _, family, kind = line.split(" ")
+            assert kind in ("counter", "gauge", "histogram")
+            families.add(family)
+        else:
+            assert _SAMPLE_RE.match(line), line
+            name = line.split("{")[0].split(" ")[0]
+            base = re.sub(r"_(total|bucket|sum|count)$", "", name)
+            assert name in families or base in families, line
+    # counters follow the _total convention
+    assert "cstpu_p_ctr_total 7" in lines
+    # histogram buckets are cumulative with the mandatory +Inf == count
+    buckets = [line for line in lines if line.startswith("cstpu_p_h_bucket")]
+    counts = [int(line.rsplit(" ", 1)[1]) for line in buckets]
+    assert counts == sorted(counts) and buckets[-1].endswith("} 3")
+    assert "cstpu_p_h_count 3" in lines
+    # span aggregates exposed as labeled counters
+    assert any(line.startswith('cstpu_span_total{span="p.span"}')
+               for line in lines)
+
+
+def test_beacon_api_serves_metrics(spec):
+    from consensus_specs_tpu.api.beacon_node import BeaconNodeAPI
+    state = factories.seed_genesis_state(spec, 8)
+    api = BeaconNodeAPI(spec, state)
+    T.counter("api.test").inc()
+    text = api.get_metrics()
+    assert "cstpu_api_test_total 1" in text
+    # served even while syncing: the operational surface stays up
+    api.syncing.is_syncing = True
+    assert "cstpu_api_test_total 1" in api.get_metrics()
+    assert "traceEvents" in api.get_trace()
+
+
+def test_chrome_trace_schema_and_dump(tmp_path):
+    with T.span("trace.a"):
+        with T.span("trace.b", idx=3):
+            pass
+    doc = T.chrome_trace()
+    events = doc["traceEvents"]
+    assert len(events) == 2
+    for event in events:
+        assert event["ph"] == "X"
+        assert set(event) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+        assert event["ts"] >= 0 and event["dur"] >= 0
+    assert [e["ts"] for e in events] == sorted(e["ts"] for e in events)
+    child = next(e for e in events if e["name"] == "trace.b")
+    assert child["args"]["parent"] == "trace.a" and child["args"]["idx"] == 3
+    path = tmp_path / "trace.json"
+    T.dump_chrome_trace(str(path))
+    assert json.loads(path.read_text())["traceEvents"]
+
+
+def test_jsonl_sink(tmp_path):
+    path = tmp_path / "telemetry.jsonl"
+    T.counter("sink.n").inc()
+    T.write_jsonl(str(path), extra={"stage": "one"})
+    T.counter("sink.n").inc()
+    T.write_jsonl(str(path))
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(rows) == 2
+    assert rows[0]["stage"] == "one"
+    assert rows[0]["counters"]["sink.n"] == 1
+    assert rows[1]["counters"]["sink.n"] == 2
+
+
+# ---------------------------------------------------------------------------
+# watchdogs
+# ---------------------------------------------------------------------------
+
+def test_retrace_watchdog_fires_on_shape_polymorphic_loop():
+    f = jax.jit(lambda x: x * 2 + 1)
+    base = T.counter("watchdog.retrace_events").value
+    with pytest.warns(T.TelemetryWarning, match="retracing"):
+        for n in range(1, 6):
+            W.dispatch("test.poly", f, jnp.ones(n))
+    stats = W.stats("test.poly")
+    assert stats["calls"] == 5 and stats["compiles"] == 5
+    assert stats["events"] == 4      # first compile is warm-up, rest are not
+    assert T.counter("watchdog.retrace_events").value - base == 4
+
+
+def test_retrace_watchdog_silent_on_cache_hits():
+    f = jax.jit(lambda x: x - 1)
+    for _ in range(5):
+        W.dispatch("test.stable", f, jnp.ones(7))
+    assert W.stats("test.stable")["events"] == 0
+
+
+def test_retrace_watchdog_noop_when_disabled():
+    T.set_enabled(False)
+    f = jax.jit(lambda x: x + 3)
+    for n in range(1, 5):
+        W.dispatch("test.off", f, jnp.ones(n))
+    assert W.stats("test.off") == {"calls": 0, "compiles": 0, "events": 0}
+
+
+def _serving_mesh(min_devices=2):
+    from consensus_specs_tpu.parallel.sharding import ServingMesh
+    n = 1
+    while n * 2 <= min(8, len(jax.devices())):
+        n *= 2
+    if n < min_devices:
+        pytest.skip(f"needs >= {min_devices} devices, have {len(jax.devices())}")
+    return ServingMesh.create(n)
+
+
+def test_relayout_watchdog_fires_on_placement_change():
+    mesh = _serving_mesh()
+    x = jnp.zeros((16, 8), jnp.uint32)
+    W.layout_check("test.layout", jax.device_put(x, mesh.shard_v))
+    base = T.counter("watchdog.relayout_events").value
+    with pytest.warns(T.TelemetryWarning, match="re-laying-out"):
+        W.layout_check("test.layout", jax.device_put(x, mesh.replicated))
+    assert T.counter("watchdog.relayout_events").value - base == 1
+    # and settles once the new placement is steady
+    W.layout_check("test.layout", jax.device_put(x, mesh.replicated))
+    assert T.counter("watchdog.relayout_events").value - base == 1
+
+
+def test_watchdogs_silent_on_layout_stable_resident_loop(spec):
+    """ISSUE 8 acceptance, test-scale: >= 4 chained resident slot steps
+    plus one epoch boundary under the validator-axis mesh report ZERO
+    retrace and ZERO re-layout events — the runtime form of the pjit
+    staging contract the serving loop was built around (PR 6)."""
+    from consensus_specs_tpu.models.phase0.resident import ResidentCore
+    mesh = _serving_mesh()
+    state = factories.seed_genesis_state(spec, 4 * spec.SLOTS_PER_EPOCH)
+    factories.advance_slots(spec, state, 2)
+    core = ResidentCore(spec, state, mesh=mesh)
+    try:
+        # one full warm-up epoch (first compiles are free for the
+        # watchdog; the measured window below is the steady state)
+        spe = spec.SLOTS_PER_EPOCH
+        target = (state.slot // spe + 1) * spe + 1
+        core.process_slots(state, target)
+        retrace0 = T.counter("watchdog.retrace_events").value
+        relayout0 = T.counter("watchdog.relayout_events").value
+        core.process_slots(state, target + spe)   # >= 4 slots + 1 boundary
+        assert T.counter("watchdog.retrace_events").value == retrace0
+        assert T.counter("watchdog.relayout_events").value == relayout0
+        # the boundary ran, span-derived timings carry the historic keys
+        assert set(core.timings) == {"stage", "device", "refresh"}
+        assert all(v > 0 for v in core.timings.values())
+        spans = T.snapshot()["spans"]
+        assert spans["resident.device"]["count"] >= 2
+        assert spans["resident.slot_root"]["count"] >= spe + 4
+    finally:
+        core.exit()
+
+
+def test_process_epoch_soa_span_derived_timings(spec):
+    from consensus_specs_tpu.models.phase0.epoch_soa import process_epoch_soa
+    state = factories.seed_genesis_state(spec, 2 * spec.SLOTS_PER_EPOCH)
+    factories.advance_slots(spec, state, 2)
+    timings = {}
+    process_epoch_soa(spec, deepcopy(state), timings=timings)
+    assert set(timings) == {"distill", "perm", "device", "writeback"}
+    assert timings["device"] > 0 and timings["distill"] > 0
+    spans = T.snapshot()["spans"]
+    assert spans["epoch.device"]["count"] == 1
+    assert spans["epoch.distill"]["count"] == 2   # cols + inputs segments
+
+
+# ---------------------------------------------------------------------------
+# no-op mode overhead
+# ---------------------------------------------------------------------------
+
+def test_noop_mode_overhead_bound():
+    """CSTPU_TELEMETRY=0 must make the layer disappear: the disabled span
+    is a shared singleton and a span+counter round trip stays under a
+    generous per-op bound (typical is well under 1 us)."""
+    T.set_enabled(False)
+    assert T.span("a") is T.span("b")
+    n = 20_000
+    ctr = T.counter("off.ctr")
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with T.span("off.span") as sp:
+            sp.fence(None)
+        ctr.inc()
+    per_op = (time.perf_counter() - t0) / n
+    assert per_op < 20e-6, f"no-op overhead {per_op * 1e6:.2f} us/op"
+    assert ctr.value == 0
+    T.set_enabled(True)
+    assert "off.span" not in T.snapshot()["spans"]

@@ -10,3 +10,4 @@ from . import sharding_collective  # noqa: F401
 from . import pallas_kernels  # noqa: F401
 from . import spec_drift    # noqa: F401
 from . import wide_accumulation  # noqa: F401
+from . import honest_timing  # noqa: F401

@@ -25,6 +25,39 @@ def sync(x):
     return np.asarray(leaf.ravel()[0:1])
 
 
+class _Stages:
+    """Linear stage marker: `stages.next("followup.x")` closes the
+    previous stage's telemetry span (printing its wall time + the
+    watchdog counters so far) and opens the next — the per-stage
+    snapshot embedding without restructuring the linear script."""
+
+    def __init__(self, telemetry):
+        self._t = telemetry
+        self._cur = None
+
+    def next(self, name=None):
+        if self._cur is not None:
+            self._cur.__exit__(None, None, None)
+            agg = self._t.snapshot()["spans"].get(self._cur.name)
+            if agg is not None:
+                print(f"[telemetry] {self._cur.name}: "
+                      f"{agg['last_ms']:.0f} ms | watchdog retrace="
+                      f"{self._t.counter('watchdog.retrace_events').value} "
+                      f"relayout="
+                      f"{self._t.counter('watchdog.relayout_events').value}",
+                      flush=True)
+            self._cur = None
+        if name is not None:
+            self._cur = self._t.span(name)
+            self._cur.__enter__()
+
+    def finish(self):
+        import json
+        self.next(None)
+        print("[telemetry] snapshot: "
+              + json.dumps(self._t.snapshot()), flush=True)
+
+
 def main():
     import os
 
@@ -43,9 +76,14 @@ def main():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     print("devices:", jax.devices(), flush=True)
 
+    from consensus_specs_tpu import telemetry
     from consensus_specs_tpu.crypto import bls12_381 as gt
     from consensus_specs_tpu.ops import decompress as D
     from consensus_specs_tpu.ops.bls_jax import JaxBackend, hash_to_g2_batch
+
+    telemetry.watchdog.install_compile_listener()
+    stages = _Stages(telemetry)
+    stages.next("followup.decompress_aggregate")
 
     # 1) batched G1 decompress: 256 pubkeys, oracle spot-check
     enc = [gt.privtopub(k) for k in range(1, 17)] * 16
@@ -83,6 +121,7 @@ def main():
     hash_to_g2_batch([(bytes([m]) * 32, 2) for m in range(8)])
     print(f"hash_to_g2 batch8 steady: {time.time()-t0:.2f}s", flush=True)
 
+    stages.next("followup.sha_pallas_ab")
     # Sections 4/4b need the real Mosaic pipeline: the unrolled SHA form
     # trips XLA:CPU's algebraic-simplifier rewrite loop (ops/sha256.py) and
     # the compiled Pallas lowering exists only for TPU. Gating them on the
@@ -117,6 +156,7 @@ def main():
         print("[skip] unrolled-SHA + Pallas A/B (TPU-only lowering; "
               "CPU smoke mode)", flush=True)
 
+    stages.next("followup.roofline")
     # 4c) roofline accounting (VERDICT r4 #4): per kernel, the modeled
     #     bytes/ops, the measured wall-clock, and the implied fraction of
     #     chip peak — so "is this actually fast?" has a denominator.
@@ -233,6 +273,7 @@ def main():
           f"{t_pair*1e3:.0f} ms fence-corrected = {8/t_pair:.1f} aggverify/s "
           f"(per-group cost amortizes further at G=128)", flush=True)
 
+    stages.next("followup.epoch_profile")
     # 5) epoch sub-stage profile (which term dominates the ~400 ms?)
     from consensus_specs_tpu.models import phase0
     from consensus_specs_tpu.models.phase0.epoch_soa import (
@@ -270,6 +311,7 @@ def main():
         print(f"stable argsort alone: {(time.perf_counter()-t0)*1e3:.0f} ms",
               flush=True)
 
+    stages.next("followup.config3_block")
     # 6) the config-3 batched block pipeline on chip: a minimal-preset block
     #    of real attestations through process_attestations_batched ->
     #    verify_indexed_batch (grouped G1 agg, batched G2 decompress,
@@ -311,6 +353,7 @@ def main():
         bls.bls_active = old_active
         bls.set_backend("python")
 
+    stages.finish()
     print("ALL TPU FOLLOW-UP CHECKS PASSED", flush=True)
     return 0
 
