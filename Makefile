@@ -8,7 +8,7 @@ PYTHON ?= python
 VECTOR_DIR ?= out/vectors
 JUNIT ?= out/test-results.xml
 
-.PHONY: test testall citest citest-cov citest-mainnet lint analyze vectors vectors-minimal bench bench-cpu multichip telemetry smoke clean
+.PHONY: test testall citest citest-cov citest-mainnet lint analyze contracts vectors vectors-minimal bench bench-cpu multichip telemetry smoke clean
 
 # measured 90.64% on the round-5 full suite; floor set just under so real
 # regressions fail while normal drift doesn't
@@ -64,6 +64,21 @@ analyze:
 		--baseline tools/analysis/baseline.json --json out/analysis.json \
 		--reference-root $(REFERENCE_ROOT)
 
+# Trace-tier contract analyzer (tools/analysis/trace/): traces/lowers the
+# REAL jitted kernels named by the modules' TRACE_CONTRACTS and ratchets
+# measured op budgets (REDC lanes, dependent add chains, pair-hash lanes,
+# collective inventory, chained out/in shardings, donation survival, f64/
+# callback/transfer hygiene) against the committed
+# tools/analysis/trace_baseline.json. Pins XLA:CPU with 8 virtual devices
+# itself, so it runs identically on CI and laptops. Exit 0 = every budget
+# met. JSON artifact: out/contracts.json. Tighten a budget by editing the
+# contract next to its kernel; loosen one via --update-trace-baseline.
+contracts:
+	mkdir -p out
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.analysis --trace \
+		--trace-baseline tools/analysis/trace_baseline.json \
+		--json out/contracts.json
+
 # Conformance vectors, both presets (reference: make gen_yaml_tests).
 vectors:
 	$(PYTHON) -m consensus_specs_tpu.generators -o $(VECTOR_DIR)
@@ -93,14 +108,17 @@ multichip:
 telemetry:
 	$(PYTHON) tools/telemetry_smoke.py
 
-# Quick health check: lint + static analysis + the fast test modules.
+# Quick health check: lint + static analysis (both tiers) + the fast
+# test modules. `make contracts` rides here so an op-budget regression
+# fails at smoke time, before any bench run.
 smoke:
 	$(PYTHON) tools/lint.py consensus_specs_tpu tests bench.py __graft_entry__.py tools
 	$(PYTHON) -m tools.analysis --list-rules >/dev/null
 	$(PYTHON) -m tools.analysis consensus_specs_tpu bench.py __graft_entry__.py \
 		--baseline tools/analysis/baseline.json \
 		--reference-root $(REFERENCE_ROOT)
-	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py tests/test_telemetry.py -q -m "not slow"
+	$(MAKE) contracts
+	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_trace_contracts.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py tests/test_telemetry.py -q -m "not slow"
 
 clean:
 	rm -rf out .pytest_cache $(VECTOR_DIR)

@@ -1449,7 +1449,24 @@ def main():
     # the full registry snapshot rides the artifact: per-stage span wall
     # times, REDC/forest/scalar-mul counters, watchdog event totals
     record["telemetry"] = telemetry.snapshot()
+    # ... and the static contract-budget snapshot next to it (declared
+    # kernel budgets + the committed trace-baseline values), so a bench
+    # capture and the op budgets it ran under are cross-checkable in ONE
+    # artifact — e.g. pairing_redc_ab's measured lane counts against the
+    # miller/verdict contracts' pins. Pure declaration reads: nothing is
+    # traced here (`make contracts` does the measuring).
+    record["contracts"] = _contract_snapshot()
     print(json.dumps(record))
+
+
+def _contract_snapshot():
+    try:
+        from tools.analysis.trace import engine as _trace_engine
+        contracts = _trace_engine.discover()
+        return {"budgets": _trace_engine.budget_snapshot(contracts),
+                "baseline": _trace_engine.load_trace_baseline()}
+    except Exception as exc:   # a broken registry must not sink a capture
+        return {"error": f"{type(exc).__name__}: {exc}"}
 
 
 if __name__ == "__main__":

@@ -1132,3 +1132,39 @@ def synthetic_epoch_state(cfg: EpochConfig, V: int, rng,
         shard_comm_balance=jnp.asarray(comm_bal),
     )
     return cols, scal, inp
+
+
+# ---------------------------------------------------------------------------
+# Trace-tier kernel contract (tools/analysis/trace/, `make contracts`)
+# ---------------------------------------------------------------------------
+# The fused epoch program at a canonical minimal-preset shape: graph-size
+# ratchet, f64/callback/transfer hygiene, and — the resident epoch
+# boundary's buffer-reuse guarantee — every ValidatorColumns input's
+# donation must survive lowering of the donated form (the variant
+# accelerator backends dispatch; CPU runs undonated for the persistent-
+# cache aliasing reason documented at epoch_transition_device).
+
+def _epoch_contract_build():
+    from . import get_spec
+    cfg = EpochConfig.from_spec(get_spec("minimal"))
+    cols, scal, inp = synthetic_epoch_state(
+        cfg, 64, np.random.default_rng(1))
+    return dict(
+        fn=_epoch_transition_traced,
+        args=(cfg, cols, scal, inp),
+        jit_kwargs=dict(static_argnums=(0,), donate_argnums=(1,)))
+
+
+TRACE_CONTRACTS = [
+    dict(
+        name="models.phase0.epoch_soa.epoch_transition",
+        build=_epoch_contract_build,
+        # f64_ops pinned at exactly 2: ops/intmath.isqrt_u64's deliberate
+        # float64 Newton seed (exact for n < 2^63, one-step corrected).
+        # Any OTHER float64 creeping into the uint64 Gwei math fails.
+        budgets={"jaxpr_eqns": 2_000, "f64_ops": 2},
+        exact=("f64_ops",),
+        forbid=("callback", "device_put"),
+        donate_min=len(ValidatorColumns._fields),
+    ),
+]

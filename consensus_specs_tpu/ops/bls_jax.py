@@ -983,3 +983,85 @@ class JaxBackend:
         x, y, inf = g1_scalar_mul(jnp.asarray(gx), jnp.asarray(gy), k)
         assert not bool(np.asarray(inf))
         return gt.compress_g1((F.from_mont(np.asarray(x)), F.from_mont(np.asarray(y))))
+
+
+# ---------------------------------------------------------------------------
+# Trace-tier kernel contracts (tools/analysis/trace/, `make contracts`)
+# ---------------------------------------------------------------------------
+# The two programs grouped_pairing_check actually dispatches (the grouped
+# Miller loop and the batched verdict = final exponentiation + fq12_eq),
+# traced at the spec shape (G = 1 group x P = 3 pairs) under BOTH
+# reduction backends. The exact lane pins make PR 5's headline cut a
+# standing machine-checked invariant: leaf/coeff whole-path lanes
+# (672 + 3094) / (396 + 967) = 2.76x, the >= 2.5x bound bench.py's
+# pairing_redc_ab row measures at runtime. Plus the cofactor-clearing
+# dependent-add model (PR 4's G2 headline), whose measured counterpart
+# is ops/scalar_mul.py's counted-chain contract.
+
+def _pairing_contract(name, fn_factory, args_factory, mode, lanes):
+    return dict(
+        name=f"ops.bls_jax.{name}[{mode}]",
+        build=lambda: dict(
+            fn=fn_factory(), args=args_factory(),
+            context=lambda: F.pinned_fq_redc_backend(mode)),
+        budgets={"redc_lanes": lanes},
+        exact=("redc_lanes",),
+        forbid=("f64", "callback", "device_put"),
+    )
+
+
+def _miller_args():
+    return (jnp.zeros((1, 3, 2, F.L), jnp.int64),
+            jnp.zeros((1, 3, 2, 2, F.L), jnp.int64))
+
+
+def _verdict_args():
+    return (jnp.zeros((1, 2, 3, 2, F.L), jnp.int64),)
+
+
+def _windowed_g1_build():
+    """The windowed scalar-mul device program (fori form, one traced
+    jac_add/jac_double instance each) at the 256-bit shape."""
+    rec = SM.recode_signed_windows(gt.r - 1, 256, 4)
+    gx, gy = g1_to_limbs(gt.G1_GEN)
+    return dict(
+        fn=lambda x, y, i, s, c: _g1_scalar_mul_win(x, y, i, s, c, w=4),
+        args=(jnp.asarray(gx)[None], jnp.asarray(gy)[None],
+              jnp.asarray(rec.idx), jnp.asarray(rec.sign),
+              jnp.asarray(np.bool_(rec.correction))))
+
+
+TRACE_CONTRACTS = [
+    _pairing_contract("miller_loop_grouped",
+                      lambda: miller_loop_grouped, _miller_args, mode, lanes)
+    for mode, lanes in (("coeff", 396), ("leaf", 672))
+] + [
+    _pairing_contract("grouped_verdict",
+                      lambda: _grouped_verdict, _verdict_args, mode, lanes)
+    for mode, lanes in (("coeff", 967), ("leaf", 3094))
+] + [
+    dict(
+        name="ops.bls_jax.windowed_scalar_mul_g1",
+        build=_windowed_g1_build,
+        budgets={"jaxpr_eqns": 60_000},
+        forbid=("f64", "callback", "device_put"),
+    ),
+    dict(
+        # PR 4's analytic dependent-add model at the two hot shapes; the
+        # op-by-op measured twin is ops.scalar_mul.windowed_chain
+        name="ops.bls_jax.cofactor_clear_model",
+        measure=lambda: {
+            "seq_adds_window": SM.sequential_adds(
+                "window", _G2_COFACTOR_NBITS, 4),
+            "seq_adds_double_add": SM.sequential_adds(
+                "double_add", _G2_COFACTOR_NBITS),
+            "seq_adds_window_256": SM.sequential_adds("window", 256, 4),
+            "seq_adds_double_add_256": SM.sequential_adds(
+                "double_add", 256),
+        },
+        budgets={"seq_adds_window": 135, "seq_adds_double_add": 507,
+                 "seq_adds_window_256": 72, "seq_adds_double_add_256": 256},
+        exact=("seq_adds_window", "seq_adds_double_add",
+               "seq_adds_window_256", "seq_adds_double_add_256"),
+    ),
+]

@@ -686,3 +686,57 @@ def fq12_frobenius(a, k: int):
     else:
         c = a
     return fq2_mul(c, jnp.asarray(_FROB[k]))
+
+
+# ---------------------------------------------------------------------------
+# Trace-tier kernel contracts (tools/analysis/trace/, `make contracts`)
+# ---------------------------------------------------------------------------
+# Plain-data declarations of the tower's traced-graph invariants: one REDC
+# per output coefficient under the default `coeff` backend, the per-leaf
+# `leaf` oracle's counts as the ratio's denominator, and f64/callback/
+# device_put hygiene on the lowered programs. The lane budgets are EXACT
+# pins — tests/test_fq_redc.py asserts the same numbers through the
+# contract engine, so the op model has one source of truth here.
+
+def _tower_contract(name, build_fn, mode, lanes):
+    return dict(
+        name=f"ops.fq_tower.{name}[{mode}]",
+        build=lambda: dict(
+            fn=build_fn(),
+            args=_contract_args(name),
+            context=lambda: F.pinned_fq_redc_backend(mode)),
+        budgets={"redc_lanes": lanes},
+        exact=("redc_lanes",),
+        forbid=("f64", "callback", "device_put"),
+    )
+
+
+def _contract_args(name):
+    # UNBATCHED canonical shapes: the documented lane counts are per-op
+    # (a leading batch axis scales lanes linearly and is the caller's)
+    z2 = jnp.zeros((2, F.L), jnp.int64)
+    z12 = jnp.zeros((2, 3, 2, F.L), jnp.int64)
+    return {
+        "fq2_mul": (z2, z2),
+        "fq12_mul": (z12, z12),
+        "fq12_sqr": (z12,),
+        "fq12_mul_line": (z12, z2),
+        "fq12_cyclo_sqr": (z12,),
+    }[name]
+
+
+def _line_wrapper():
+    return lambda f, c: fq12_mul_line(f, c, c, c)
+
+
+TRACE_CONTRACTS = [
+    _tower_contract(n, b, mode, lanes)
+    for n, b, modes in (
+        ("fq2_mul", lambda: fq2_mul, {"coeff": 2, "leaf": 3}),
+        ("fq12_mul", lambda: fq12_mul, {"coeff": 12, "leaf": 54}),
+        ("fq12_sqr", lambda: fq12_sqr, {"coeff": 12, "leaf": 36}),
+        ("fq12_mul_line", _line_wrapper, {"coeff": 12, "leaf": 39}),
+        ("fq12_cyclo_sqr", lambda: fq12_cyclo_sqr, {"coeff": 12, "leaf": 30}),
+    )
+    for mode, lanes in modes.items()
+]

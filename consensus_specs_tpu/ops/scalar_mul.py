@@ -380,3 +380,39 @@ def sequential_doubles(backend: str, nbits: int, w: Optional[int] = None) -> int
         return nbits
     assert backend == "window" and w is not None
     return (1 if w > 1 else 0) + w * (n_windows(nbits, w) - 1)
+
+
+# ---------------------------------------------------------------------------
+# Trace-tier kernel contract (tools/analysis/trace/, `make contracts`)
+# ---------------------------------------------------------------------------
+# The measured arm of the dependent-add cost model: an UNROLLED eager
+# windowed evaluation at a small shape, counted op-by-op through the
+# shared tracer's counted_point_ops (the counter that used to be
+# hand-rolled in tests/test_scalar_mul.py), pinned exactly to
+# sequential_adds/sequential_doubles — the model the hot-shape budgets
+# in ops.bls_jax.cofactor_clear_model are computed from.
+
+def _windowed_chain_build():
+    from . import bls_jax as BJ
+    from ..crypto import bls12_381 as gt
+    nbits, w = 24, 3
+    k = 0b101100111010110011101011 - 1   # even: exercises the fixup add
+    rec = recode_signed_windows(k, nbits, w)
+    arr = BJ.g1_to_limbs(gt.ec_mul(gt.G1_GEN, 9))
+    return dict(
+        fn=lambda x, y: windowed_scalar_mul(
+            BJ.G1_OPS, (x, y), rec.idx, rec.sign, rec.correction,
+            w=w, unroll=True),
+        args=(jnp.asarray(arr[0]), jnp.asarray(arr[1])))
+
+
+TRACE_CONTRACTS = [
+    dict(
+        name="ops.scalar_mul.windowed_chain",
+        build=_windowed_chain_build,
+        count_point_ops=True,
+        budgets={"seq_adds": sequential_adds("window", 24, 3),
+                 "seq_doubles": sequential_doubles("window", 24, 3)},
+        exact=("seq_adds", "seq_doubles"),
+    ),
+]

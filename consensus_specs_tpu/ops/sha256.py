@@ -406,3 +406,24 @@ def jax_pair_hasher(blocks: List[bytes]) -> List[bytes]:
 def install_device_hasher() -> None:
     from ..utils.hash import set_pair_hasher
     set_pair_hasher(jax_pair_hasher)
+
+
+# ---------------------------------------------------------------------------
+# Trace-tier kernel contract (tools/analysis/trace/, `make contracts`)
+# ---------------------------------------------------------------------------
+# One Merkle pair-hash level at a canonical 8-lane batch: the graph-size
+# ratchet guards the 2x64-round compression structure (a silently
+# doubled round count or a dead extra compression shows up as an eqn
+# jump), and the hygiene scans keep the bulk Merkleizer's inner loop
+# free of f64 upcasts, host callbacks, and staged transfers.
+
+TRACE_CONTRACTS = [
+    dict(
+        name="ops.sha256.pair_hash_level",
+        build=lambda: dict(
+            fn=lambda w: sha256_pairs_inner(w),
+            args=(jnp.zeros((8, 16), jnp.uint32),)),
+        budgets={"jaxpr_eqns": 3_000},
+        forbid=("f64", "callback", "device_put"),
+    ),
+]

@@ -349,23 +349,29 @@ class ServingMesh:
         return _watchdog.dispatch(("mesh.balchunks", self.size, vp, p2),
                                   fn, balances)
 
+    def forest_build_shardings(self, capacity: int):
+        """(in_shardings, out_shardings) of the forest-build program at a
+        pow2 capacity — one definition shared by forest_build_jit and the
+        trace-tier contract, so the contract checks the REAL placement."""
+        from ..utils.merkle import tree_depth
+        assert capacity & (capacity - 1) == 0, capacity
+        return ((self.row_sharding(capacity),),
+                tuple(self.row_sharding(capacity >> d)
+                      for d in range(tree_depth(capacity) + 1)))
+
     def forest_build_jit(self, capacity: int):
         """One traced program building EVERY level of a pow2 `capacity`-leaf
         forest, each level placed per row_sharding — per-shard subtree
         levels stay on their shard, the cap levels replicate (the join of
         the per-shard roots happens once, inside this program)."""
         from ..utils.ssz.incremental import _build_levels
-        from ..utils.merkle import tree_depth
 
-        assert capacity & (capacity - 1) == 0, capacity
         key = ("build", capacity)
         fn = self._jits.get(key)
         if fn is None:
-            out_sh = tuple(self.row_sharding(capacity >> d)
-                           for d in range(tree_depth(capacity) + 1))
+            in_sh, out_sh = self.forest_build_shardings(capacity)
             fn = jax.jit(_build_levels,
-                         in_shardings=(self.row_sharding(capacity),),
-                         out_shardings=out_sh)
+                         in_shardings=in_sh, out_shardings=out_sh)
             self._jits[key] = fn
         wkey = ("mesh.forest_build", self.size, capacity)
         return lambda leaves, _fn=fn: _watchdog.dispatch(wkey, _fn, leaves)
@@ -382,3 +388,84 @@ def trees_bitwise_equal(a, b) -> bool:
         if xn.dtype != yn.dtype or xn.shape != yn.shape or not (xn == yn).all():
             return False
     return True
+
+
+# ---------------------------------------------------------------------------
+# Trace-tier kernel contracts (tools/analysis/trace/, `make contracts`)
+# ---------------------------------------------------------------------------
+# The ServingMesh dispatch contracts, checked STATICALLY on the lowered
+# programs (the compile-time counterpart of telemetry/watchdog.py's
+# re-layout check): the sharded epoch program's lowered out-shardings
+# must equal its in-shardings position-for-position across the chained
+# (cols, scal) prefix — so consecutive slot/epoch boundaries pass device
+# arrays straight through — and its compiled collective inventory is
+# pinned, so a jax/XLA/kernel change that starts re-sharding mid-program
+# (a new all-to-all on the serving path) fails before any bench run.
+# Runs on the 8-device virtual CPU mesh; skips (with a notice) when the
+# process has fewer devices.
+
+_CONTRACT_MESH_DEVICES = 8
+
+
+def _mesh_epoch_chain_build():
+    from ..models.phase0 import get_spec
+    from ..models.phase0.epoch_soa import (
+        EpochConfig, synthetic_epoch_state)
+    import numpy as _np
+
+    serving = ServingMesh.create(_CONTRACT_MESH_DEVICES)
+    cfg = EpochConfig.from_spec(get_spec("minimal"))
+    cols, scal, inp = synthetic_epoch_state(
+        cfg, 64 * serving.size, _np.random.default_rng(1))
+    cols_sh, scal_sh, inp_sh = serving.epoch_shardings()
+    report_sh = EpochReport(*([serving.replicated] * len(EpochReport._fields)))
+    return dict(
+        fn=partial(_epoch_transition_traced, cfg),
+        args=(cols, scal, inp),
+        jit_kwargs=dict(in_shardings=(cols_sh, scal_sh, inp_sh),
+                        out_shardings=(cols_sh, scal_sh, report_sh)))
+
+
+def _forest_build_build():
+    import jax.numpy as jnp
+    from ..utils.ssz.incremental import _build_levels
+
+    serving = ServingMesh.create(_CONTRACT_MESH_DEVICES)
+    capacity = 64
+    in_sh, out_sh = serving.forest_build_shardings(capacity)
+    return dict(
+        fn=_build_levels,
+        args=(jnp.zeros((capacity, 8), jnp.uint32),),
+        jit_kwargs=dict(in_shardings=in_sh, out_shardings=out_sh))
+
+
+TRACE_CONTRACTS = [
+    dict(
+        name="parallel.sharding.mesh_epoch_chain",
+        build=_mesh_epoch_chain_build,
+        requires_devices=_CONTRACT_MESH_DEVICES,
+        # the chained prefix: every ValidatorColumns and EpochScalars
+        # leaf (outputs 0..13) must come back under the SAME sharding
+        # annotation its matching input carries (out == next in)
+        chained_prefix=(len(ValidatorColumns._fields)
+                        + len(EpochScalars._fields)),
+        # the epoch program's budgeted cross-device traffic: balance-sum
+        # / justification reductions (all-reduce) plus the activation-
+        # queue sort's gathers — anything beyond this inventory is a new
+        # reshard on the serving path
+        collectives=("all-gather", "all-reduce"),
+        budgets={"collective_ops": 20, "f64_ops": 2},
+        exact=("f64_ops",),
+        forbid=("callback", "device_put"),
+    ),
+    dict(
+        name="parallel.sharding.forest_build",
+        build=_forest_build_build,
+        requires_devices=_CONTRACT_MESH_DEVICES,
+        # per-shard subtrees build shard-locally; the only traffic is the
+        # gather joining shard roots into the replicated cap levels
+        collectives=("all-gather",),
+        budgets={"collective_ops": 8},
+        forbid=("f64", "callback", "device_put"),
+    ),
+]

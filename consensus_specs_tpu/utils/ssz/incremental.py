@@ -400,3 +400,33 @@ def tree_from_chunks(chunks: np.ndarray,
     words = (np.zeros((0, 8), np.uint32) if chunks.shape[0] == 0
              else bytes_to_words(chunks))   # reshape of 0 rows is ill-defined
     return IncrementalMerkleTree(words, pair_fn=pair_fn)
+
+
+# ---------------------------------------------------------------------------
+# Trace-tier kernel contract (tools/analysis/trace/, `make contracts`)
+# ---------------------------------------------------------------------------
+# PR 3's O(dirty * log V) invariant as exact pair-lane pins at a
+# canonical shape: a 64-leaf forest costs exactly n-1 = 63 pair lanes to
+# build, and a 2-dirty update re-hashes only the two root paths (11
+# lanes here — they merge two levels below the root). A kernel change
+# that silently rebuilds a level (or the whole forest) on update shows
+# up as a lane jump long before bench.py's incremental_root row moves.
+
+def _forest_lane_measure():
+    leaves = np.arange(64 * 8, dtype=np.uint32).reshape(64, 8)
+    tree = IncrementalMerkleTree(leaves)
+    build_lanes = sum(tree.last_pairs_per_level)
+    tree.update(np.array([3, 40]), np.zeros((2, 8), np.uint32))
+    update_lanes = sum(tree.last_pairs_per_level)
+    return {"build_pair_lanes": build_lanes,
+            "update_pair_lanes": update_lanes}
+
+
+TRACE_CONTRACTS = [
+    dict(
+        name="utils.ssz.incremental.forest_pair_lanes",
+        measure=_forest_lane_measure,
+        budgets={"build_pair_lanes": 63, "update_pair_lanes": 11},
+        exact=("build_pair_lanes", "update_pair_lanes"),
+    ),
+]

@@ -869,6 +869,64 @@ def test_honest_timing_negative_no_dispatch(tmp_path):
     assert findings_for(tmp_path, src) == []
 
 
+def test_honest_timing_flags_attribute_call_dispatch(tmp_path):
+    """The documented CSA1001 gap, closed: an unfenced delta around a
+    module-ATTRIBUTE dispatch (`kern.f_jit(x)`) of a jitted name
+    resolved through the call-graph IR."""
+    root = _write_pkg(tmp_path, {
+        "kern.py": ("import jax\ndef _f(x):\n    return x\n"
+                    "f_jit = jax.jit(_f)\n"),
+        "drv.py": ("import time\nfrom . import kern\n"
+                   "def bench(x):\n"
+                   "    t0 = time.perf_counter()\n"
+                   "    y = kern.f_jit(x)\n"
+                   "    dt = time.perf_counter() - t0\n"
+                   "    return y, dt\n"),
+    })
+    found = [f for f in findings_for_dir(root) if f.rule == "CSA1001"]
+    assert len(found) == 1
+    assert found[0].path.endswith("drv.py")
+    assert found[0].context == "bench"
+
+
+def test_honest_timing_attribute_call_negative_fenced_and_unjitted(
+        tmp_path):
+    # a fenced attribute dispatch is clean, and an attribute call whose
+    # target module has no such jitted name never fires
+    root = _write_pkg(tmp_path, {
+        "kern.py": ("import jax\ndef _f(x):\n    return x\n"
+                    "f_jit = jax.jit(_f)\n"
+                    "def host_helper(x):\n    return x\n"),
+        "drv.py": ("import time\nimport numpy as np\nfrom . import kern\n"
+                   "def bench(x):\n"
+                   "    t0 = time.perf_counter()\n"
+                   "    y = kern.f_jit(x)\n"
+                   "    np.asarray(y)\n"
+                   "    dt = time.perf_counter() - t0\n"
+                   "    t1 = time.perf_counter()\n"
+                   "    z = kern.host_helper(x)\n"
+                   "    return y, z, dt, time.perf_counter() - t1\n"),
+    })
+    assert [f for f in findings_for_dir(root) if f.rule == "CSA1001"] == []
+
+
+def test_honest_timing_attribute_call_suppressible(tmp_path):
+    root = _write_pkg(tmp_path, {
+        "kern.py": ("import jax\ndef _f(x):\n    return x\n"
+                    "f_jit = jax.jit(_f)\n"),
+        "drv.py": ("import time\nfrom . import kern\n"
+                   "def bench(x):\n"
+                   "    t0 = time.perf_counter()\n"
+                   "    y = kern.f_jit(x)\n"
+                   "    # csa: ignore[CSA1001] -- launch-overhead probe\n"
+                   "    dt = time.perf_counter() - t0\n"
+                   "    return y, dt\n"),
+    })
+    report = analyze_paths([str(root)])
+    assert [f for f in report.findings if f.rule == "CSA1001"] == []
+    assert [f.rule for f in report.suppressed] == ["CSA1001"]
+
+
 def test_honest_timing_suppression(tmp_path):
     src = _JIT_PREAMBLE + (
         "def bench(x):\n"

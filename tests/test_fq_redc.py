@@ -15,7 +15,6 @@ import random
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from consensus_specs_tpu.crypto import bls12_381 as gt
@@ -295,59 +294,17 @@ def test_cyclo_sqr_chained_50_coeff():
 # ---------------------------------------------------------------------------
 # Traced REDC lane counts (the acceptance bound)
 # ---------------------------------------------------------------------------
+# The jaxpr walkers (`fresh_jaxpr` / `qinv_mul_lanes`) this section
+# hand-rolled through PR 8 now live in the shared tracer library the
+# contract engine uses (tools/analysis/trace/tracer.py) — one source of
+# truth for the REDC op model; these tests assert the same numbers the
+# trace tier ratchets (`make contracts`).
 
-def _iter_subjaxprs(params):
-    for v in params.values():
-        stack = [v]
-        while stack:
-            x = stack.pop()
-            if isinstance(x, jax.core.ClosedJaxpr):
-                yield x.jaxpr, x.consts
-            elif isinstance(x, jax.core.Jaxpr):
-                yield x, []
-            elif isinstance(x, (list, tuple)):
-                stack.extend(x)
+from tools.analysis.trace import engine as trace_engine  # noqa: E402
+from tools.analysis.trace import tracer  # noqa: E402
 
-
-def qinv_mul_lanes(closed) -> int:
-    """Total REDC lanes in a traced program, read off the jaxpr itself:
-    each REDC instance multiplies by the Montgomery constant QINV_NEG
-    exactly L times (once per interleaved-reduction step), and each such
-    multiply's shape is the stacked lane batch. Nothing else multiplies
-    by that 29-bit constant, so lanes = sum(prod(shape)) / L. Loop bodies
-    (fori/scan/cond) count once — these are traced-graph counts."""
-    total = 0
-
-    def walk(jaxpr, consts):
-        nonlocal total
-        env = dict(zip(jaxpr.constvars, consts))
-        for eqn in jaxpr.eqns:
-            for sub, sub_consts in _iter_subjaxprs(eqn.params):
-                walk(sub, sub_consts)
-            if eqn.primitive.name != "mul":
-                continue
-            for iv in eqn.invars:
-                if isinstance(iv, jax.core.Literal):
-                    val = iv.val
-                elif iv in env:
-                    val = env[iv]
-                else:
-                    continue
-                if np.ndim(val) == 0 and int(val) == F.QINV_NEG:
-                    total += int(np.prod(eqn.outvars[0].aval.shape, dtype=np.int64))
-                    break
-
-    walk(closed.jaxpr, closed.consts)
-    assert total % F.L == 0, total
-    return total // F.L
-
-
-def _fresh_jaxpr(fn, *xs):
-    """Trace through a FRESH wrapper so jax's trace cache (keyed on
-    function identity + avals, blind to the backend global) cannot hand
-    back the other mode's jaxpr — the very staleness bls_jax.py's
-    mode-keyed jitted programs exist to prevent."""
-    return jax.make_jaxpr(lambda *a: fn(*a))(*xs)
+_fresh_jaxpr = tracer.fresh_jaxpr
+qinv_mul_lanes = tracer.qinv_mul_lanes
 
 
 @pytest.mark.parametrize("name,leaf_lanes,coeff_lanes", [
@@ -402,6 +359,36 @@ def test_grouped_pairing_traced_lane_cut():
             _fresh_jaxpr(BJ.final_exponentiation_3x, f12)
             lanes[mode] = F.redc_trace_stats()["lanes"]
     assert lanes["leaf"] >= 2.5 * lanes["coeff"], lanes
+
+
+def test_fq_tower_contracts_clean_and_pinned():
+    """The tower's lane counts asserted THROUGH the contract engine: the
+    committed TRACE_CONTRACTS run clean against the committed
+    trace_baseline.json, every budget is an exact pin the engine
+    re-measured, and the pins match this file's expectation table — so
+    the test suite and `make contracts` cannot drift apart."""
+    want = {
+        "fq2_mul": (3, 2), "fq12_mul": (54, 12), "fq12_sqr": (36, 12),
+        "fq12_mul_line": (39, 12), "fq12_cyclo_sqr": (30, 12)}
+    contracts = [c for c in trace_engine.discover()
+                 if c["name"].startswith("ops.fq_tower.")]
+    assert len(contracts) == 2 * len(want)
+    report = trace_engine.run_contracts(contracts)
+    assert report.findings == [], [f.message for f in report.findings]
+    measured = {r.name: r.measured for r in report.results}
+    for op, (leaf, coeff) in want.items():
+        assert measured[f"ops.fq_tower.{op}[leaf]"]["redc_lanes"] == leaf
+        assert measured[f"ops.fq_tower.{op}[coeff]"]["redc_lanes"] == coeff
+    # the pairing-path contracts' exact pins carry the >=2.5x whole-path
+    # lane cut (miller + verdict, leaf vs coeff) as committed budgets
+    budgets = {c["name"]: c["budgets"] for c in trace_engine.discover()
+               if c["name"].startswith("ops.bls_jax.")}
+    leaf_total = (budgets["ops.bls_jax.miller_loop_grouped[leaf]"]["redc_lanes"]
+                  + budgets["ops.bls_jax.grouped_verdict[leaf]"]["redc_lanes"])
+    coeff_total = (
+        budgets["ops.bls_jax.miller_loop_grouped[coeff]"]["redc_lanes"]
+        + budgets["ops.bls_jax.grouped_verdict[coeff]"]["redc_lanes"])
+    assert leaf_total >= 2.5 * coeff_total, (leaf_total, coeff_total)
 
 
 # ---------------------------------------------------------------------------

@@ -15,6 +15,14 @@ and small states) and tools/lint.py (syntax/style only):
   CSA7xx  pallas          BlockSpec/grid/Ref contracts of pallas_call
   CSA8xx  spec-drift      constants + signatures vs the reference pyspec
 
+A second, trace tier (tools/analysis/trace/ — the only part that
+imports jax) operates on the REAL jaxprs/StableHLO of the hot kernels
+via declarative TRACE_CONTRACTS exported next to the kernels:
+
+  CSA11xx jaxpr op-budget ratchet (REDC lanes, dependent add chains)
+  CSA12xx lowered-program hygiene (f64, callbacks, transfers, donation)
+  CSA13xx collective/layout inventory drift (chained shardings)
+
 The per-module passes run over each file's jit context; trace context
 propagates across module boundaries through the call-graph IR
 (callgraph.py), and program-level passes (CSA6xx, CSA8xx) run once over
@@ -31,3 +39,6 @@ See tools/analysis/README.md for the rule catalog and suppression syntax
 from .core import (Finding, Rule, RULES, PASSES, register_pass,  # noqa: F401
                    register_rule, analyze_paths, load_baseline)
 from . import passes  # noqa: F401  (importing registers the passes)
+from . import trace   # noqa: F401  (registers the trace-tier rule catalog;
+#                       stdlib-only — tracing itself lives in trace/engine.py,
+#                       loaded lazily by the CLI's --trace path)

@@ -16,8 +16,9 @@ Detection (per statement block, nested bodies of the timed region
 included):
 
     t0 = time.perf_counter()          # opens a timed region for `t0`
-    y = f_jit(x)                      # jitted dispatch (local jit map,
-                                      #   plain-name calls — CSA501 scope)
+    y = f_jit(x)                      # jitted dispatch (plain name, or an
+                                      #   attribute call `m.f_jit(x)` of a
+                                      #   module whose jit map names it)
     dt = time.perf_counter() - t0     # closes the region -> FINDING if no
                                       #   fence call appeared in between
 
@@ -26,17 +27,23 @@ A region also closes at the next `t1 = time.perf_counter()` assignment
 a new region opens. Fences recognized anywhere in the region:
 `block_until_ready`, `device_get`, `np.asarray`/`np.array`/`onp.asarray`,
 `.tolist()`, `.item()`, and calls to a local `_sync`/`sync` helper.
-Heuristic and local by design: attribute-call dispatches
-(`bulk.some_jit(...)`) and cross-block `t0` captures are out of scope —
-the goal is catching the pattern the repo itself used to hand-roll, at
-zero false positives on the shipped tree.
+
+Dispatch resolution is a program pass over the call-graph IR: plain-name
+calls resolve through the module's own jit map (imported jitted names
+included — callgraph's fixpoint already folds `from m import f_jit` in),
+and attribute calls `mod.f_jit(...)` resolve the base through the
+program's import graph to the defining module's jitted names — the
+dispatch form bench.py and the resident loop actually use, which PR 1's
+per-module pass documented as out of scope. Cross-block `t0` captures
+remain out of scope (the goal is catching the pattern the repo itself
+used to hand-roll, at zero false positives on the shipped tree).
 """
 from __future__ import annotations
 
 import ast
 
-from ..core import Finding, register_pass, register_rule
-from .. import jitmap
+from ..core import Finding, register_program_pass, register_rule
+from .. import callgraph, jitmap
 
 register_rule(
     "CSA1001",
@@ -102,14 +109,29 @@ def _has_fence(calls) -> bool:
     return False
 
 
-def _has_jitted_dispatch(calls, jitted_names) -> bool:
-    for call in calls:
-        if isinstance(call.func, ast.Name) and call.func.id in jitted_names:
-            return True
-    return False
+def _make_dispatch_resolver(node, program):
+    """A predicate `is_jitted_dispatch(call)` for one module: plain-name
+    calls against the module's own jitted names (imported names included
+    — the callgraph fixpoint folded those in), attribute calls against
+    the jitted names of the module their base resolves to through the
+    program's import graph."""
+    own_jitted = set(node.info.jit_map.jitted_names)
+
+    def is_jitted_dispatch(call: ast.Call) -> bool:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return func.id in own_jitted
+        if isinstance(func, ast.Attribute):
+            base = jitmap._dotted(func.value)
+            target = callgraph.resolve_module(node, base, program)
+            if target is not None and target is not node:
+                return func.attr in target.info.jit_map.jitted_names
+        return False
+
+    return is_jitted_dispatch
 
 
-def _scan_block(stmts, mod, jitted_names, context, findings) -> None:
+def _scan_block(stmts, mod, is_dispatch, context, findings) -> None:
     open_vars = {}          # timer var -> index of its perf_counter assign
     for i, stmt in enumerate(stmts):
         # close first: `t1 = perf_counter()` both closes open regions
@@ -121,7 +143,7 @@ def _scan_block(stmts, mod, jitted_names, context, findings) -> None:
         for var in closers:
             start = open_vars[var]
             region = list(_region_calls(stmts[start + 1:i]))
-            if _has_jitted_dispatch(region, jitted_names) \
+            if any(is_dispatch(c) for c in region) \
                     and not _has_fence(region):
                 findings.append(Finding(
                     "CSA1001", mod.path, stmt.lineno,
@@ -144,20 +166,22 @@ def _scan_block(stmts, mod, jitted_names, context, findings) -> None:
         for attr in ("body", "orelse", "finalbody"):
             inner = getattr(stmt, attr, None)
             if inner:
-                _scan_block(inner, mod, jitted_names, context, findings)
+                _scan_block(inner, mod, is_dispatch, context, findings)
         for handler in getattr(stmt, "handlers", ()) or ():
-            _scan_block(handler.body, mod, jitted_names, context, findings)
+            _scan_block(handler.body, mod, is_dispatch, context, findings)
 
 
-@register_pass
-def run(mod):
-    jitted_names = set(mod.jit_map.jitted_names)
-    if not jitted_names:
-        return []
+@register_program_pass
+def run(program):
     findings = []
-    _scan_block(mod.tree.body, mod, jitted_names, "<module>", findings)
-    for node in ast.walk(mod.tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            _scan_block(node.body, mod, jitted_names, mod.qualname(node),
-                        findings)
+    for node in program.modules.values():
+        mod = node.info
+        if "perf_counter" not in mod.source:
+            continue
+        is_dispatch = _make_dispatch_resolver(node, program)
+        _scan_block(mod.tree.body, mod, is_dispatch, "<module>", findings)
+        for fn in ast.walk(mod.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_block(fn.body, mod, is_dispatch, mod.qualname(fn),
+                            findings)
     return findings
