@@ -8,7 +8,7 @@ PYTHON ?= python
 VECTOR_DIR ?= out/vectors
 JUNIT ?= out/test-results.xml
 
-.PHONY: test testall citest citest-cov citest-mainnet lint analyze contracts vectors vectors-minimal bench bench-cpu multichip telemetry smoke clean
+.PHONY: test testall citest citest-cov citest-mainnet lint analyze contracts ranges vectors vectors-minimal bench bench-cpu multichip telemetry smoke clean
 
 # measured 90.64% on the round-5 full suite; floor set just under so real
 # regressions fail while normal drift doesn't
@@ -79,6 +79,22 @@ contracts:
 		--trace-baseline tools/analysis/trace_baseline.json \
 		--json out/contracts.json
 
+# Value-range tier (tools/analysis/ranges/): an interval abstract
+# interpreter over the REAL jaxprs of the kernels' RANGE_CONTRACTS —
+# proves the limb/column magnitude budgets (|col| < 2^35 into fq_redc,
+# narrow limbs back to [-16, 2^29], shuffle int32 at the 2^30 ceiling,
+# uint64 Gwei math at 10M validators) and the declared wrap semantics
+# (SHA-256's mod-2^32), ratcheting the proven intervals against the
+# committed tools/analysis/ranges_baseline.json (CSA1401-1404). Ceiling
+# shapes trace via ShapeDtypeStruct, so the whole run is ~15 s of pure
+# interpretation — no arrays, no devices. Exit 0 = every budget proven.
+# JSON artifact: out/ranges.json. Loosen via --update-ranges-baseline.
+ranges:
+	mkdir -p out
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.analysis --ranges \
+		--ranges-baseline tools/analysis/ranges_baseline.json \
+		--json out/ranges.json
+
 # Conformance vectors, both presets (reference: make gen_yaml_tests).
 vectors:
 	$(PYTHON) -m consensus_specs_tpu.generators -o $(VECTOR_DIR)
@@ -108,9 +124,10 @@ multichip:
 telemetry:
 	$(PYTHON) tools/telemetry_smoke.py
 
-# Quick health check: lint + static analysis (both tiers) + the fast
-# test modules. `make contracts` rides here so an op-budget regression
-# fails at smoke time, before any bench run.
+# Quick health check: lint + static analysis (all three tiers) + the
+# fast test modules. `make contracts` and `make ranges` ride here so an
+# op-budget or value-range regression fails at smoke time, before any
+# bench run.
 smoke:
 	$(PYTHON) tools/lint.py consensus_specs_tpu tests bench.py __graft_entry__.py tools
 	$(PYTHON) -m tools.analysis --list-rules >/dev/null
@@ -118,7 +135,8 @@ smoke:
 		--baseline tools/analysis/baseline.json \
 		--reference-root $(REFERENCE_ROOT)
 	$(MAKE) contracts
-	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_trace_contracts.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py tests/test_telemetry.py -q -m "not slow"
+	$(MAKE) ranges
+	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_trace_contracts.py tests/test_range_contracts.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py tests/test_telemetry.py -q -m "not slow"
 
 clean:
 	rm -rf out .pytest_cache $(VECTOR_DIR)

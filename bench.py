@@ -1456,6 +1456,11 @@ def main():
     # miller/verdict contracts' pins. Pure declaration reads: nothing is
     # traced here (`make contracts` does the measuring).
     record["contracts"] = _contract_snapshot()
+    # ... and the range-contract snapshot (declared output bounds + the
+    # committed proven-interval baseline) next to the trace-tier one, so
+    # a capture also records the value budgets its kernels were proven
+    # under. Pure declaration reads again: `make ranges` does the proving.
+    record["ranges"] = _ranges_snapshot()
     print(json.dumps(record))
 
 
@@ -1465,6 +1470,16 @@ def _contract_snapshot():
         contracts = _trace_engine.discover()
         return {"budgets": _trace_engine.budget_snapshot(contracts),
                 "baseline": _trace_engine.load_trace_baseline()}
+    except Exception as exc:   # a broken registry must not sink a capture
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _ranges_snapshot():
+    try:
+        from tools.analysis.ranges import engine as _ranges_engine
+        contracts = _ranges_engine.discover()
+        return {"declared": _ranges_engine.declared_snapshot(contracts),
+                "baseline": _ranges_engine.load_ranges_baseline()}
     except Exception as exc:   # a broken registry must not sink a capture
         return {"error": f"{type(exc).__name__}: {exc}"}
 

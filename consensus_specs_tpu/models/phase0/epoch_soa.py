@@ -134,8 +134,13 @@ def _stage_a_traced(cfg: EpochConfig, cols: ValidatorColumns,
     FAR = u64(cfg.FAR_FUTURE_EPOCH)
 
     current_epoch = scal.slot // u64(cfg.SLOTS_PER_EPOCH)
-    previous_epoch = jnp.where(current_epoch == u64(cfg.GENESIS_EPOCH),
-                               u64(cfg.GENESIS_EPOCH), current_epoch - u64(1))
+    # saturating -1: identical to `current_epoch - 1` on every lane the
+    # where() keeps (current != GENESIS implies current >= GENESIS + 1),
+    # and provably wrap-free for the range tier (make ranges) even on
+    # the unreachable current < 1 lanes the raw subtraction wraps on
+    previous_epoch = jnp.where(
+        current_epoch == u64(cfg.GENESIS_EPOCH), u64(cfg.GENESIS_EPOCH),
+        jnp.maximum(current_epoch, u64(1)) - u64(1))
 
     active_curr = (cols.activation_epoch <= current_epoch) & (current_epoch < cols.exit_epoch)
     active_prev = (cols.activation_epoch <= previous_epoch) & (previous_epoch < cols.exit_epoch)
@@ -208,7 +213,12 @@ def _stage_a_traced(cfg: EpochConfig, cols: ValidatorColumns,
         src_set, base_reward * u64(cfg.MIN_ATTESTATION_INCLUSION_DELAY) // delay, u64(0))
 
     # Inactivity penalty (:1431-1440)
-    finality_delay = previous_epoch - finalized
+    # saturating: finalized <= previous_epoch is a chain invariant (an
+    # epoch finalizes only after it was previous), so the min() changes
+    # nothing on reachable states — it makes the inactivity product
+    # eff * finality_delay provably wrap-free (make ranges) instead of
+    # multiplying by a wrapped ~2^64 delay on a corrupt state
+    finality_delay = previous_epoch - jnp.minimum(finalized, previous_epoch)
     inactivity = finality_delay > u64(cfg.MIN_EPOCHS_TO_INACTIVITY_PENALTY)
     tgt_set = inp.prev_tgt & unslashed
     penalties = penalties + jnp.where(
@@ -249,6 +259,12 @@ def _stage_a_traced(cfg: EpochConfig, cols: ValidatorColumns,
     count_at_base = jnp.sum((cols.exit_epoch == base_epoch).astype(jnp.uint64))
     c0 = jnp.minimum(count_at_base, churn)
     rank = jnp.cumsum(ejected.astype(jnp.uint64)) - ejected.astype(jnp.uint64)
+    # the has_exit select above already strips the FAR_FUTURE_EPOCH
+    # sentinel (2^64-1) from real states, but the interval domain keeps
+    # the sentinel in exit_epoch's hull, so the range tier cannot
+    # exclude base_epoch ~ 2^64 here; real base_epoch is bounded by the
+    # largest genuine exit epoch and the add cannot wrap
+    # csa: ignore[CSA1401] -- FAR sentinel lanes are select-masked
     assigned = base_epoch + (c0 + rank) // churn
     exit_epoch = jnp.where(ejected, assigned, cols.exit_epoch)
     withdrawable = jnp.where(
@@ -1166,5 +1182,75 @@ TRACE_CONTRACTS = [
         exact=("f64_ops",),
         forbid=("callback", "device_put"),
         donate_min=len(ValidatorColumns._fields),
+    ),
+]
+
+
+# ---------------------------------------------------------------------------
+# Value-range contract (tools/analysis/ranges/, `make ranges`)
+# ---------------------------------------------------------------------------
+# The uint64 Gwei/index arithmetic of the WHOLE epoch transition at the
+# 10M-validator ceiling, mainnet constants, traced over
+# ShapeDtypeStructs (nothing allocates 10M-row columns). What is
+# proven: effective-balance sums (10^7 * MAX_EFFECTIVE_BALANCE < 2^58),
+# base-reward products, the proposer scatter-add at full duplicate
+# fan-in, exit-queue/activation-queue counts, the int32 att_proposer
+# index at V = 10^7, and the slashing table's int64 3x window — none of
+# it can wrap uint64/int64/int32. What is DECLARED rather than proven:
+# saturating subtractions (`uint64:sub` — the where-masked balance
+# decrease idiom), the justification bitfield's shifted-out bit
+# (`uint64:shl`), ops/intmath.py's documented 128-bit wrap machinery
+# (replaced by exact summaries via `wrap_ok_sources`), and the
+# FAR_FUTURE_EPOCH sentinel add inline-suppressed at its site above.
+
+def _epoch_ranges_build():
+    import jax as _jax
+    from . import get_spec
+    cfg = EpochConfig.from_spec(get_spec("mainnet"))
+    V = 10_000_000
+    S = _jax.ShapeDtypeStruct
+    b = S((V,), jnp.bool_)
+    u = S((V,), jnp.uint64)
+    cols = ValidatorColumns(u, u, u, u, b, u, u)
+    scal = EpochScalars(*([S((), jnp.uint64)] * 6),
+                        S((cfg.LATEST_SLASHED_EXIT_LENGTH,), jnp.uint64))
+    inp = EpochInputs(b, b, b, b, u, S((V,), jnp.int32), S((V,), jnp.int32),
+                      b, S((cfg.SHARD_COUNT,), jnp.uint64),
+                      S((cfg.SHARD_COUNT,), jnp.uint64))
+    far = {"lo": 0, "hi": cfg.FAR_FUTURE_EPOCH}
+    flag = {"lo": 0, "hi": 1}
+    epoch = {"lo": 0, "hi": 1 << 19}          # ~12k years of epochs
+    ranges = (
+        ValidatorColumns(
+            activation_eligibility_epoch=far, activation_epoch=far,
+            exit_epoch=far, withdrawable_epoch=far, slashed=flag,
+            effective_balance={"lo": 0, "hi": cfg.MAX_EFFECTIVE_BALANCE},
+            balance={"lo": 0, "hi": 1 << 45}),
+        EpochScalars(
+            slot={"lo": 0, "hi": 1 << 24},
+            previous_justified_epoch=epoch, current_justified_epoch=epoch,
+            justification_bitfield={"lo": 0, "hi": (1 << 64) - 1},
+            finalized_epoch=epoch,
+            latest_start_shard={"lo": 0, "hi": cfg.SHARD_COUNT - 1},
+            latest_slashed_balances={"lo": 0, "hi": 1 << 59}),
+        EpochInputs(
+            prev_src=flag, prev_tgt=flag, prev_head=flag, curr_tgt=flag,
+            incl_delay={"lo": 1, "hi": 1 << 24},
+            att_proposer={"lo": 0, "hi": V - 1},
+            v_shard={"lo": -1, "hi": cfg.SHARD_COUNT - 1}, in_winning=flag,
+            shard_att_balance={"lo": 1, "hi": 1 << 58},
+            shard_comm_balance={"lo": 1, "hi": 1 << 58}),
+    )
+    return dict(
+        fn=lambda c, s, i: _epoch_transition_traced(cfg, c, s, i),
+        args=(cols, scal, inp), ranges=ranges)
+
+
+RANGE_CONTRACTS = [
+    dict(
+        name="models.phase0.epoch_soa.epoch_ceiling",
+        build=_epoch_ranges_build,
+        wrap_ok=("uint64:sub", "uint64:shl"),
+        wrap_ok_sources=("ops/intmath.py",),
     ),
 ]

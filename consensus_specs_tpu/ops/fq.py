@@ -48,30 +48,45 @@ lanes along a batch axis (see fq_tower's bilinear fq12 product) is the
 intended usage pattern — the traced graph is the same size for a batch of 2
 and a batch of 10^6.
 
-Laziness budget (enforced by usage convention, asserted in tests):
+Laziness budget — MACHINE-CHECKED: the constants below are exported as
+module constants, declared in this module's RANGE_CONTRACTS, and proven
+by the value-range tier's interval interpreter over the real jaxprs
+(tools/analysis/ranges/, `make ranges`, rules CSA1401-1404);
+tests/test_range_contracts.py asserts these documented numbers equal
+the contract constants so prose and prover cannot drift apart:
 
-- *Narrow domain* (`[..., L]`, inputs to fq_mul/fq_mul_wide): limbs
-  |l| < ~2^32 (three defensive carry rounds bring them to [-1, 2^29]),
-  values sums/differences of at most ~2^10 Montgomery outputs
-  (|v| < 2^10 * 2q < 2^393, keeping |v_a|*|v_b| < q*R = 2^787). Tower
-  pre-sums keep well under this (<= 8 terms).
+- *Narrow domain* (`[..., L]`, inputs to fq_mul/fq_mul_wide): body
+  limbs |l| <= NARROW_INPUT_BOUND = 2^32 with the top limb carrying
+  only the value spill |l_13| <= NARROW_TOP_SPILL = 2^16 (values are
+  sums/differences of at most ~2^10 Montgomery outputs: |v| < 2^10 *
+  2q < 2^393, so the top limb holds < 2^(393-377); canonical elements
+  x < q have top limb <= CANONICAL_TOP = q >> 377 = 13). Three
+  defensive carry rounds provably crush the body into
+  [NARROW_LIMB_LO, NARROW_LIMB_HI] = [-16, 2^29] (the hand ripple
+  argument gives [-1, 2^29]; the committed interval proof carries the
+  slightly looser machine floor).
 - *Wide domain* (`[..., 2L]` columns): a single `fq_mul_wide` of
-  normalized operands yields |col| <= 14*2^58 < 2^62 — NO headroom for
-  accumulation (three raw products already overflow int64). Any >2-term
-  wide accumulation must interpose `fq_wide_norm` (value-preserving wide
-  carry rounds, |col| back to [-1, 2^29]) first; the static analyzer
-  flags violations (CSA901). `fq_redc` accepts |col| < 2^35 — the
-  64-abs-fan-in gamma ceiling fq_tower's `_check_budget` enforces times
-  2^29 — and its output window is (v/R - q, v/R + q), i.e. (-2q, 2q)
-  whenever |value| < q*R; iterated additive passthroughs must enter the
-  wide domain through a reduction-free multiply by one (value <= |a|*q,
-  keeps the window contracting — fq_tower.fq12_cyclo_sqr), not the
-  shift-lift `fq_wide_from_mont` (value |a|*R, window grows per step).
+  normalized operands yields |col| <= WIDE_COL_RAW = 14*2^58 < 2^62 —
+  NO headroom for accumulation (three raw products already overflow
+  int64). Any >2-term wide accumulation must interpose `fq_wide_norm`
+  (value-preserving wide carry rounds, body back to [-16, 2^29])
+  first; CSA901 pre-checks this syntactically and the range tier
+  proves it on the traced values. `fq_redc` accepts body columns
+  |col| < WIDE_COL_BUDGET = WIDE_ACCUM_FANIN * 2^29 = 2^35 (the
+  gamma fan-in ceiling fq_tower's `_check_budget` enforces) plus a
+  top column carrying only spill |col_27| < WIDE_TOP_SPILL = 2^38,
+  and its output window is (v/R - q, v/R + q), i.e. (-2q, 2q)
+  whenever |value| < q*R; iterated additive passthroughs must enter
+  the wide domain through a reduction-free multiply by one (value <=
+  |a|*q, keeps the window contracting — fq_tower.fq12_cyclo_sqr), not
+  the shift-lift `fq_wide_from_mont` (value |a|*R, window grows per
+  step).
 """
 from __future__ import annotations
 
 import contextlib
 import os
+from functools import partial
 from typing import Optional, Sequence
 
 import numpy as np
@@ -95,6 +110,22 @@ R2_MONT = (R_MONT * R_MONT) % Q
 QINV_NEG = pow(-Q, -1, 1 << B)   # -q^{-1} mod 2^B (Montgomery constant)
 
 NORM_FULL = L + 3           # rounds for exact ripple propagation
+
+# ---------------------------------------------------------------------------
+# Laziness-budget constants (the module docstring's numbers, exported so
+# the RANGE_CONTRACTS below — and fq_tower's — declare and prove exactly
+# these; tests/test_range_contracts.py pins doc prose == constants)
+# ---------------------------------------------------------------------------
+
+NARROW_LIMB_LO = -16                    # proven post-norm body floor
+NARROW_LIMB_HI = 1 << B                 # proven post-norm body ceiling (2^29)
+NARROW_INPUT_BOUND = 1 << 32            # declared |body limb| budget into mul
+NARROW_TOP_SPILL = 1 << 16              # declared top-limb spill (|v| < 2^393)
+CANONICAL_TOP = Q >> (B * (L - 1))      # = 13: top limb of canonical x < q
+WIDE_COL_RAW = L << (2 * B)             # 14*2^58: one raw schoolbook column
+WIDE_ACCUM_FANIN = 64                   # gamma abs-fan-in ceiling (fq_tower)
+WIDE_COL_BUDGET = WIDE_ACCUM_FANIN << B  # 2^35: fq_redc body-column budget
+WIDE_TOP_SPILL = 1 << 38                # fq_redc top-column (spill) budget
 
 
 def int_to_limbs(x: int) -> np.ndarray:
@@ -224,7 +255,26 @@ def _carry_rounds(t, n: int):
     the top limb keeps its own overflow in place, so values up to int64
     range at the top limb survive; callers keep |value| < ~2^395 narrow /
     < q*R wide). Length-generic: works on [..., L] elements and
-    [..., 2L] wide columns alike."""
+    [..., 2L] wide columns alike.
+
+    Under `staged_helpers()` (the value-range tier's tracing context,
+    `make ranges`) the body routes through a jitted twin so the call
+    boundary survives into enclosing jaxprs as a NAMED pjit eqn, which
+    the interval interpreter replaces with its EXACT per-position
+    transfer — new[k] = (old[k] & MASK) + (old[k-1] >> B), top =
+    old[top] + (old[top-1] >> B) — because the positional interval
+    domain cannot see the (x & MASK) + ((x >> B) << B) == x cancellation
+    and would otherwise grow the top limb ~2^29 per round. Production
+    and test paths keep the helper inlined: an always-on jit boundary
+    measured ~5x slower on the eager scalar-mul chains (per-call
+    dispatch on a micro-op), and nested jit inlines at lowering anyway,
+    so the staged and inline forms compile identically."""
+    if _STAGE_HELPERS:
+        return _carry_rounds_staged(t, n)
+    return _carry_rounds_impl(t, n)
+
+
+def _carry_rounds_impl(t, n: int):
     for _ in range(n):
         lo = t & MASK
         hi = t >> B          # arithmetic shift: borrows propagate as -1
@@ -234,6 +284,29 @@ def _carry_rounds(t, n: int):
         t = lo + up
         t = t.at[..., -1].add(top << B)
     return t
+
+
+_carry_rounds_staged = partial(jax.jit, static_argnums=(1,))(
+    _carry_rounds_impl)
+
+_STAGE_HELPERS = False
+
+
+@contextlib.contextmanager
+def staged_helpers():
+    """Trace-scope switch: stage _carry_rounds as a named jit call so
+    analysis tiers can see (and summarize) the helper boundary. The
+    range engine enters this around every contract trace; nothing else
+    should."""
+    # trace-time-once is the point (the flag pins staging for the
+    # duration of one make_jaxpr; nothing reads it at run time)
+    global _STAGE_HELPERS
+    prev = _STAGE_HELPERS
+    _STAGE_HELPERS = True
+    try:
+        yield
+    finally:
+        _STAGE_HELPERS = prev
 
 
 def fq_norm(a, rounds: int = 3):
@@ -523,3 +596,86 @@ def fq_sqrt_candidate(a):
     Caller must check candidate^2 == a (reference decompress_g1,
     crypto/bls12_381.py:361-378 does the same check)."""
     return _fq_pow_static(a, _SQRT_EXP_BITS)
+
+
+# ---------------------------------------------------------------------------
+# Value-range contracts (tools/analysis/ranges/, `make ranges`)
+# ---------------------------------------------------------------------------
+# The laziness budget as machine-checked theorems over the real jaxprs:
+# each contract declares the documented input intervals (body limbs +
+# the top-limb value spill, positional along the trailing axis) and the
+# interval interpreter PROVES the declared output bound and the absence
+# of int64 wraparound anywhere in the traced program. Shapes carry a
+# small leading batch axis — the kernels are elementwise over batch, and
+# batched indexing stages positional slice/scatter ops the interpreter
+# tracks exactly.
+
+def _narrow_spec():
+    """The lazy narrow-domain input budget (module docstring)."""
+    return {"lo": -NARROW_INPUT_BOUND, "hi": NARROW_INPUT_BOUND,
+            "top_lo": -NARROW_TOP_SPILL, "top_hi": NARROW_TOP_SPILL}
+
+
+def _canonical_spec():
+    """Canonical elements x < q: limbs in [0, 2^29), top <= q >> 377."""
+    return {"lo": 0, "hi": MASK, "top_lo": 0, "top_hi": CANONICAL_TOP}
+
+
+def _norm_out_spec(top_lo, top_hi):
+    return {"lo": NARROW_LIMB_LO, "hi": NARROW_LIMB_HI,
+            "top_lo": top_lo, "top_hi": top_hi}
+
+
+def _z(shape):
+    return jnp.zeros(shape, jnp.int64)
+
+
+RANGE_CONTRACTS = [
+    dict(
+        # the schoolbook at canonical operands: every column <= 14*2^58
+        # and column 27 is IDENTICALLY ZERO (the structural fact that
+        # keeps chained fq_mul top limbs small)
+        name="ops.fq.fq_mul_wide",
+        build=lambda: dict(fn=fq_mul_wide, args=(_z((2, L)), _z((2, L))),
+                           ranges=(_canonical_spec(), _canonical_spec())),
+        output={"lo": -WIDE_COL_RAW, "hi": WIDE_COL_RAW,
+                "top_lo": 0, "top_hi": 0},
+    ),
+    dict(
+        # fq_redc's documented input budget -> lazy output: body limbs
+        # land in [-16, 2^29], the top keeps only the value spill
+        name="ops.fq.fq_redc",
+        build=lambda: dict(
+            fn=fq_redc, args=(_z((2, 2 * L)),),
+            ranges=({"lo": -WIDE_COL_BUDGET, "hi": WIDE_COL_BUDGET,
+                     "top_lo": -WIDE_TOP_SPILL, "top_hi": WIDE_TOP_SPILL},)),
+        output=_norm_out_spec(-(1 << 39), 1 << 39),
+    ),
+    dict(
+        # the composed Montgomery product from the full lazy budget:
+        # no int64 wrap anywhere, output back inside the narrow budget
+        # with a tiny top limb (mul_wide's zero column 27 in action)
+        name="ops.fq.fq_mul",
+        build=lambda: dict(fn=fq_mul, args=(_z((2, L)), _z((2, L))),
+                           ranges=(_narrow_spec(), _narrow_spec())),
+        output=_norm_out_spec(-64, 64),
+    ),
+    dict(
+        # three rounds crush the narrow body to [-16, 2^29] (top limb is
+        # value-preserving: it keeps the input spill)
+        name="ops.fq.fq_norm",
+        build=lambda: dict(
+            fn=fq_norm, args=(_z((2, L)),),
+            ranges=({"lo": -(1 << 33), "hi": 1 << 33},)),
+        output=_norm_out_spec(-((1 << 33) + 64), (1 << 33) + 64),
+    ),
+    dict(
+        # the wide re-normalization that buys gamma its accumulation
+        # headroom: raw schoolbook columns back to a [-16, 2^29] body
+        name="ops.fq.fq_wide_norm",
+        build=lambda: dict(
+            fn=fq_wide_norm, args=(_z((2, 2 * L)),),
+            ranges=({"lo": -WIDE_COL_RAW, "hi": WIDE_COL_RAW},)),
+        output=_norm_out_spec(-(1 << 62), 1 << 62),
+    ),
+]

@@ -370,14 +370,15 @@ def _derive_fq12_line_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
 def _check_budget(alpha, beta, gamma, name: str):
     # laziness check, BOTH backends: pre-sum fan-in and post-combination
     # growth must fit the budgets in ops/fq.py. Narrow (leaf): limbs
-    # <= 64*2^29 = 2^35 (crushed by fq_mul's defensive carry rounds),
-    # values <= 64*2q < 2^388, keeping |v_a|*|v_b| < q*R = 2^787. Wide
-    # (coeff): gamma rows sum wide-NORMALIZED columns (|col| <= 2^29 after
-    # the interposed fq_wide_norm), so a 64 fan-in keeps |col| < 2^35 =
-    # fq_redc's documented input bound, and values <= 64*(8*2q)^2 < 2^776
-    # < q*R (actual rows stay <= 36). A real raise: python -O must not
-    # strip it.
-    if (int(np.abs(gamma).sum(axis=1).max()) > 64
+    # <= WIDE_ACCUM_FANIN*2^29 = 2^35 (crushed by fq_mul's defensive
+    # carry rounds), values <= 64*2q < 2^388, keeping |v_a|*|v_b| < q*R
+    # = 2^787. Wide (coeff): gamma rows sum wide-NORMALIZED columns
+    # (body <= 2^29 after the interposed fq_wide_norm), so the fan-in
+    # ceiling keeps |col| < F.WIDE_COL_BUDGET = 2^35 = fq_redc's input
+    # bound — the RANGE_CONTRACTS below prove it on the traced values —
+    # and values <= 64*(8*2q)^2 < 2^776 < q*R (actual rows stay <= 36).
+    # A real raise: python -O must not strip it.
+    if (int(np.abs(gamma).sum(axis=1).max()) > F.WIDE_ACCUM_FANIN
             or int(np.abs(alpha).sum(axis=1).max()) > 8
             or int(np.abs(beta).sum(axis=1).max()) > 8):
         raise ValueError(f"{name} tables exceed the fq laziness budget")
@@ -505,17 +506,29 @@ def _apply_int_matrix(mat: np.ndarray, x):
     return jnp.stack(rows, axis=-2)
 
 
+def _bilinear_wide_cols(alpha, beta, gamma, av, bv):
+    """The gamma-recombined wide columns — the EXACT array fq_redc
+    consumes under the coeff backend. Exposed as its own function so the
+    value-range tier can pin the REDC input budget (body columns inside
+    |col| < F.WIDE_COL_BUDGET = 2^35, top column spill-only) on the real
+    computation: the RANGE_CONTRACTS below prove the theorem CSA901 only
+    gestures at syntactically."""
+    A = _apply_int_matrix(alpha, av)
+    Bv = _apply_int_matrix(beta, bv)
+    Pw = F.fq_wide_norm(F.fq_mul_wide(A, Bv))             # [..., N, 2L]
+    return _apply_int_matrix(gamma, Pw)                   # [..., 12, 2L]
+
+
 def _bilinear(alpha, beta, gamma, av, bv):
     """The shared bilinear core: pre-sums, stacked leaf products, gamma
     recombination. coeff: leaves stay wide (one interposed fq_wide_norm
     restores accumulation headroom), gamma runs over the wide columns,
     and ONE fq_redc reduces the 12 output coefficients. leaf: one fq_mul
     reduces every leaf, gamma runs narrow (the differential oracle)."""
+    if _coeff():
+        return F.fq_redc(_bilinear_wide_cols(alpha, beta, gamma, av, bv))
     A = _apply_int_matrix(alpha, av)
     Bv = _apply_int_matrix(beta, bv)
-    if _coeff():
-        Pw = F.fq_wide_norm(F.fq_mul_wide(A, Bv))         # [..., N, 2L]
-        return F.fq_redc(_apply_int_matrix(gamma, Pw))    # [..., 12, L]
     P = F.fq_mul(A, Bv)                                   # [..., N, L]
     return _apply_int_matrix(gamma, P)
 
@@ -588,9 +601,29 @@ def fq12_cyclo_sqr(a):
     coeff = _coeff()
     if coeff:
         z_src = F.fq_norm(a)
+        red = F.fq_redc(_cyclo_sqr_wide_cols(z_src))      # [..., 6, 2, L]
+        out = [red[..., e, :, :] for e in range(6)]
     else:
         z_src = F.fq_mul(a.reshape(a.shape[:-4] + (12, F.L)),
                          F.fq_ones(())).reshape(a.shape)
+        out = _cyclo_sqr_terms(z_src, coeff=False)
+    rows = [jnp.stack([out[2 * i + j] for i in range(3)], axis=-3)
+            for j in range(2)]
+    return jnp.stack(rows, axis=-4)
+
+
+def _cyclo_sqr_wide_cols(z_src):
+    """[..., 6, 2, 2L] wide columns entering the single cyclo-squaring
+    fq_redc under the coeff backend — exposed (like _bilinear_wide_cols)
+    so the range tier proves the 3X ± 2z sums stay inside the
+    F.WIDE_COL_BUDGET REDC input budget."""
+    return jnp.stack(_cyclo_sqr_terms(z_src, coeff=True), axis=-3)
+
+
+def _cyclo_sqr_terms(z_src, coeff: bool):
+    """The six Granger–Scott output components, pre-reduction: wide
+    columns under coeff (fed to ONE fq_redc), narrow limbs under leaf.
+    `coeff` is a trace-time host bool (the backend switch)."""
     z = [z_src[..., e % 2, e // 2, :, :] for e in range(6)]
     pairs = [(z[0], z[3]), (z[1], z[4]), (z[2], z[5])]    # A, B, C
     lhs = jnp.stack([x0 + x1 for x0, x1 in pairs]
@@ -627,12 +660,7 @@ def fq12_cyclo_sqr(a):
     out[4] = x3(C2[0]) - x2(zw[4])
     out[2] = x3(B2[0]) - x2(zw[2])                        # C' = 3B² - 2C̄
     out[5] = x3(B2[1]) + x2(zw[5])
-    if coeff:
-        red = F.fq_redc(jnp.stack(out, axis=-3))          # [..., 6, 2, L]
-        out = [red[..., e, :, :] for e in range(6)]
-    rows = [jnp.stack([out[2 * i + j] for i in range(3)], axis=-3)
-            for j in range(2)]
-    return jnp.stack(rows, axis=-4)
+    return out
 
 
 def fq12_conj(a):
@@ -739,4 +767,63 @@ TRACE_CONTRACTS = [
         ("fq12_cyclo_sqr", lambda: fq12_cyclo_sqr, {"coeff": 12, "leaf": 30}),
     )
     for mode, lanes in modes.items()
+]
+
+
+# ---------------------------------------------------------------------------
+# Value-range contracts (tools/analysis/ranges/, `make ranges`)
+# ---------------------------------------------------------------------------
+# THE wide-accumulation theorem, per gamma recombination: from the lazy
+# narrow input budget (ops/fq.py: body limbs within 2^32, top limbs
+# spill-only), every column entering the coeff backend's single fq_redc
+# stays inside the documented budget — body |col| < F.WIDE_COL_BUDGET =
+# 2^35 with the top column carrying only value spill — and nothing in
+# the traced program can wrap int64. CSA901's syntactic notice gestures
+# at this; the interval interpreter PROVES it on the real jaxprs, and
+# deleting the interposed fq_wide_norm from any of these paths trips
+# CSA1401 (the seeded regression in tests/test_range_contracts.py).
+
+_REDC_COLS_OUT = {"lo": -F.WIDE_COL_BUDGET, "hi": F.WIDE_COL_BUDGET,
+                  "top_lo": -F.WIDE_TOP_SPILL, "top_hi": F.WIDE_TOP_SPILL}
+
+
+def _gamma_contract(name, tables, n_b):
+    def build():
+        import jax.numpy as _jnp
+        alpha, beta, gamma = tables()
+        av = _jnp.zeros((2, alpha.shape[1], F.L), _jnp.int64)
+        bv = _jnp.zeros((2, n_b, F.L), _jnp.int64)
+        spec = F._narrow_spec()      # the ONE lazy narrow-domain budget
+        return dict(
+            fn=lambda a, b: _bilinear_wide_cols(alpha, beta, gamma, a, b),
+            args=(av, bv), ranges=(spec, spec),
+            context=lambda: F.pinned_fq_redc_backend("coeff"))
+    return dict(name=f"ops.fq_tower.{name}.redc_cols[coeff]", build=build,
+                output=_REDC_COLS_OUT)
+
+
+def _fq2_wide_build():
+    spec = F._narrow_spec()
+    z2 = jnp.zeros((2, 2, F.L), jnp.int64)
+    return dict(fn=_fq2_mul_wide, args=(z2, z2), ranges=(spec, spec))
+
+
+def _cyclo_cols_build():
+    spec = F._narrow_spec()
+    z12 = jnp.zeros((2, 2, 3, 2, F.L), jnp.int64)
+    return dict(fn=lambda a: _cyclo_sqr_wide_cols(F.fq_norm(a)),
+                args=(z12,), ranges=(spec,),
+                context=lambda: F.pinned_fq_redc_backend("coeff"))
+
+
+RANGE_CONTRACTS = [
+    _gamma_contract("fq12_mul", lambda: (_ALPHA, _BETA, _GAMMA), 12),
+    _gamma_contract("fq12_sqr", lambda: (_SQR_ALPHA, _SQR_BETA, _SQR_GAMMA),
+                    12),
+    _gamma_contract("fq12_mul_line",
+                    lambda: (_LINE_ALPHA, _LINE_BETA, _LINE_GAMMA), 6),
+    dict(name="ops.fq_tower.fq2_mul.redc_cols[coeff]",
+         build=_fq2_wide_build, output=_REDC_COLS_OUT),
+    dict(name="ops.fq_tower.fq12_cyclo_sqr.redc_cols[coeff]",
+         build=_cyclo_cols_build, output=_REDC_COLS_OUT),
 ]

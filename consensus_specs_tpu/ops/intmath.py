@@ -27,6 +27,17 @@ import jax.numpy as jnp  # noqa: E402
 _U32_MASK = 0xFFFFFFFF
 
 
+# The three helpers are individually jitted so each call boundary
+# survives into enclosing jaxprs as a NAMED pjit eqn: the value-range
+# tier (tools/analysis/ranges/) replaces the body — whose wrapping
+# 32-bit-pair tricks and restoring-division steps are opaque to
+# interval reasoning — with the helper's exact mathematical image
+# (math.isqrt, 128-bit product/quotient bounds). That substitution is a
+# theorem about the FUNCTION, not an assumption about the code: the
+# helpers are differentially tested bit-exact against Python bigints.
+# Nested jit inlines at lowering; the compiled programs are unchanged.
+
+@jax.jit
 def mulwide_u64(a: jnp.ndarray, b: jnp.ndarray):
     """Full 64×64→128 product of uint64 arrays, as (hi, lo) uint64 pairs."""
     a = a.astype(jnp.uint64)
@@ -47,6 +58,7 @@ def mulwide_u64(a: jnp.ndarray, b: jnp.ndarray):
     return hi, lo
 
 
+@jax.jit
 def muldiv_u64(a: jnp.ndarray, b: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
     """Exact a * b // d on uint64 arrays, via 128-bit intermediate.
 
@@ -76,6 +88,7 @@ def muldiv_u64(a: jnp.ndarray, b: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
     return quot
 
 
+@jax.jit
 def isqrt_u64(n: jnp.ndarray) -> jnp.ndarray:
     """Integer square root of uint64 arrays (reference 0_beacon-chain.md:1052-1066).
 

@@ -416,3 +416,42 @@ TRACE_CONTRACTS = [
         exact=("seq_adds", "seq_doubles"),
     ),
 ]
+
+
+# ---------------------------------------------------------------------------
+# Value-range contract (tools/analysis/ranges/, `make ranges`)
+# ---------------------------------------------------------------------------
+# Jacobian coordinate limbs across the windowed loop: from a canonical
+# affine G1 point (limbs in [0, 2^29), top limb <= q >> 377), the
+# interval interpreter walks the REAL fori_loop program — table build,
+# window trips, even-k fixup — unrolling each loop abstractly, and
+# proves no int64 wrap anywhere in the chained jac_add/jac_double field
+# ops and that the accumulator limbs stay inside the lazy narrow budget
+# (a few times 2^29; the per-mul defensive carry rounds are what keep
+# the chain from compounding). Same canonical 24-bit/w=3 shape as the
+# trace-tier chain contract above.
+
+def _windowed_ranges_build():
+    from . import bls_jax as BJ
+    from . import fq  # lazy: module-level scalar_mul stays fq-free
+    nbits, w = 24, 3
+    k = 0b101100111010110011101011 - 1   # even: exercises the fixup add
+    rec = recode_signed_windows(k, nbits, w)
+    z = jnp.zeros((2, fq.L), jnp.int64)
+    canon = {"lo": 0, "hi": fq.MASK, "top_lo": 0, "top_hi": fq.CANONICAL_TOP}
+    return dict(
+        fn=lambda x, y: windowed_scalar_mul(
+            BJ.G1_OPS, (x, y), jnp.asarray(rec.idx), jnp.asarray(rec.sign),
+            rec.correction, w=w),
+        args=(z, z), ranges=(canon, canon))
+
+
+RANGE_CONTRACTS = [
+    dict(
+        name="ops.scalar_mul.windowed_loop_limbs",
+        build=_windowed_ranges_build,
+        # X/Y/Z accumulator limbs: body within ~9*2^29, top spill-only
+        output={"lo": -(1 << 33), "hi": 1 << 33,
+                "top_lo": -(1 << 12), "top_hi": 1 << 12},
+    ),
+]

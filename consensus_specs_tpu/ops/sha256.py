@@ -427,3 +427,27 @@ TRACE_CONTRACTS = [
         forbid=("f64", "callback", "device_put"),
     ),
 ]
+
+
+# ---------------------------------------------------------------------------
+# Value-range contract (tools/analysis/ranges/, `make ranges`)
+# ---------------------------------------------------------------------------
+# SHA-256 is DEFINED over uint32 modular arithmetic: every add/rotate in
+# the 64-round compression wraps mod 2^32 by design. The contract
+# declares exactly that (`wrap_ok=("uint32",)`), so the interpreter
+# walks the real fori-form rounds without flagging a single intentional
+# wrap — while the declaration documents the wrap surface and any OTHER
+# dtype creeping into the compression (an int64 index, an f32 upcast)
+# would still be checked against ITS range.
+
+RANGE_CONTRACTS = [
+    dict(
+        name="ops.sha256.single_block_mod32",
+        build=lambda: dict(
+            fn=lambda w: sha256_single_block(w),
+            args=(jnp.zeros((4, 16), jnp.uint32),),
+            ranges=({"lo": 0, "hi": (1 << 32) - 1},)),
+        wrap_ok=("uint32",),
+        output={"lo": 0, "hi": (1 << 32) - 1},
+    ),
+]

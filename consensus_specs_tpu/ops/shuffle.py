@@ -23,7 +23,14 @@ hashes) are computed host-side where bignum mod is free.
 
 Index dtype is int32: n is asserted < 2**30 (the spec bound is 2**40, but a
 validator registry is millions, not billions; the one-point oracle
-`get_shuffled_index` retains full-range semantics).
+`get_shuffled_index` retains full-range semantics). The int32 choice is
+MACHINE-AUDITED at the ceiling: the value-range contract below
+(`make ranges`) walks all 90 rounds at n = 2**30 - 1 and proves every
+index intermediate — `pivot - pos` in (-(n-1), n-1), the `flip + n`
+renormalization peaking at 2n - 1 = 2**31 - 1, the roll/slice starts —
+stays inside int32, and the permutation contents inside [0, n-1]; any
+widening of `_MAX_N` past 2**30 (where `flip + n` would genuinely wrap)
+trips CSA1401 before it can ship.
 """
 from __future__ import annotations
 
@@ -150,6 +157,37 @@ def shuffle_permutation_on_device(seed: bytes, index_count: int, rounds: int) ->
 def shuffle_permutation_device(seed: bytes, index_count: int, rounds: int) -> np.ndarray:
     """Host-facing wrapper: same permutation, materialized as numpy int64."""
     return np.asarray(shuffle_permutation_on_device(seed, index_count, rounds), dtype=np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Value-range contract (tools/analysis/ranges/, `make ranges`)
+# ---------------------------------------------------------------------------
+# The swap-or-not round arithmetic at the maximum validator count: all
+# 90 rounds traced at n = _MAX_N - 1 (ShapeDtypeStruct — nothing
+# allocates), digest words declared intentionally mod-2^32
+# (`wrap_ok=("uint32",)`, the SHA-256 grammar), and the int32 index
+# math proven wrap-free, with the permutation contents pinned inside
+# [0, n-1]. This is the audit the module docstring cites.
+
+def _shuffle_ranges_build():
+    import jax as _jax
+    n, rounds = _MAX_N - 1, 90
+    return dict(
+        fn=lambda s, p: _shuffle_rounds(s, p, n=n, rounds=rounds),
+        args=(_jax.ShapeDtypeStruct((8,), jnp.uint32),
+              _jax.ShapeDtypeStruct((rounds,), jnp.int32)),
+        ranges=({"lo": 0, "hi": (1 << 32) - 1},      # seed words
+                {"lo": 0, "hi": _MAX_N - 2}))        # host pivots < n
+
+
+RANGE_CONTRACTS = [
+    dict(
+        name="ops.shuffle.swap_or_not_ceiling",
+        build=_shuffle_ranges_build,
+        wrap_ok=("uint32",),
+        output={"lo": 0, "hi": _MAX_N - 2},          # perm values < n
+    ),
+]
 
 
 def install_device_shuffler(min_n: int = 1 << 13) -> None:

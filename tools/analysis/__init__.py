@@ -15,13 +15,27 @@ and small states) and tools/lint.py (syntax/style only):
   CSA7xx  pallas          BlockSpec/grid/Ref contracts of pallas_call
   CSA8xx  spec-drift      constants + signatures vs the reference pyspec
 
-A second, trace tier (tools/analysis/trace/ — the only part that
-imports jax) operates on the REAL jaxprs/StableHLO of the hot kernels
-via declarative TRACE_CONTRACTS exported next to the kernels:
+A second, trace tier (tools/analysis/trace/) operates on the REAL
+jaxprs/StableHLO of the hot kernels via declarative TRACE_CONTRACTS
+exported next to the kernels:
 
   CSA11xx jaxpr op-budget ratchet (REDC lanes, dependent add chains)
   CSA12xx lowered-program hygiene (f64, callbacks, transfers, donation)
   CSA13xx collective/layout inventory drift (chained shardings)
+
+A third, value-range tier (tools/analysis/ranges/) walks the same
+jaxprs with an interval abstract interpreter, proving the declared
+limb/column magnitude budgets and wrap semantics of the kernels'
+RANGE_CONTRACTS:
+
+  CSA1401 proved-overflow violation (wrap / output bound / invariant)
+  CSA1402 unprovable-op notice (value widened to the dtype range)
+  CSA1403 missing loop invariant
+  CSA1404 range-snapshot drift vs ranges_baseline.json
+
+Both jax-touching tiers register only their rule catalogs at import
+(stdlib, for --list-rules on the no-jax lint lane); the tracing and
+interpretation machinery loads lazily behind --trace / --ranges.
 
 The per-module passes run over each file's jit context; trace context
 propagates across module boundaries through the call-graph IR
@@ -42,3 +56,6 @@ from . import passes  # noqa: F401  (importing registers the passes)
 from . import trace   # noqa: F401  (registers the trace-tier rule catalog;
 #                       stdlib-only — tracing itself lives in trace/engine.py,
 #                       loaded lazily by the CLI's --trace path)
+from . import ranges  # noqa: F401  (registers the range-tier rule catalog;
+#                       the interval interpreter lives in ranges/interp.py +
+#                       ranges/engine.py, loaded lazily by --ranges)

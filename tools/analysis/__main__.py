@@ -1,6 +1,8 @@
 """CLI: python -m tools.analysis <targets> [--json out] [--baseline b.json]
      python -m tools.analysis --trace [--trace-baseline b.json]
                               [--update-trace-baseline] [--json out]
+     python -m tools.analysis --ranges [--ranges-baseline b.json]
+                              [--update-ranges-baseline] [--json out]
 
 Exit status: 0 when every finding is inline-suppressed or baselined,
 1 when actionable findings remain, 2 on usage errors. Stale baseline
@@ -13,6 +15,13 @@ programs named by the kernels' TRACE_CONTRACTS and ratchets measured
 op budgets against the committed tools/analysis/trace_baseline.json.
 It pins XLA:CPU with 8 virtual devices before jax initializes, so
 `make contracts` runs in seconds anywhere.
+
+`--ranges` selects the value-range tier (tools/analysis/ranges/): it
+traces the programs named by the kernels' RANGE_CONTRACTS (ceiling
+shapes via ShapeDtypeStruct — nothing executes) and runs the interval
+abstract interpreter over the jaxprs, proving the declared limb/column
+budgets and wrap semantics and ratcheting the proven intervals against
+tools/analysis/ranges_baseline.json.
 """
 from __future__ import annotations
 
@@ -53,6 +62,18 @@ def main(argv=None) -> int:
     parser.add_argument("--update-trace-baseline", action="store_true",
                         help="rewrite --trace-baseline from the measured "
                              "snapshot (implies --trace)")
+    parser.add_argument("--ranges", action="store_true",
+                        help="run the value-range tier (kernel "
+                             "RANGE_CONTRACTS through the interval "
+                             "abstract interpreter) instead of the AST "
+                             "passes")
+    parser.add_argument("--ranges-baseline", metavar="PATH",
+                        help="range-tier proven-interval snapshot "
+                             "(default: tools/analysis/"
+                             "ranges_baseline.json)")
+    parser.add_argument("--update-ranges-baseline", action="store_true",
+                        help="rewrite --ranges-baseline from the proven "
+                             "snapshot (implies --ranges)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -62,6 +83,9 @@ def main(argv=None) -> int:
 
     if args.trace or args.update_trace_baseline:
         return _run_trace(args)
+
+    if args.ranges or args.update_ranges_baseline:
+        return _run_ranges(args)
 
     if not args.targets:
         parser.print_usage(sys.stderr)
@@ -123,6 +147,42 @@ def _run_trace(args) -> int:
         # the refresh just cleared the ratchet family: drop it from the
         # reported findings so the JSON artifact and exit code agree
         # with the baseline that now exists on disk
+        report.findings = remaining
+    else:
+        print(engine.render_human(report))
+    if args.json:
+        Path(args.json).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.json).write_text(engine.render_json(report) + "\n")
+    return 1 if report.findings else 0
+
+
+def _run_ranges(args) -> int:
+    from .ranges import engine
+    from .trace.engine import ensure_cpu_devices
+    ensure_cpu_devices(8)
+    baseline_path = args.ranges_baseline or engine.DEFAULT_BASELINE
+    report = engine.run_contracts(baseline_path=baseline_path)
+
+    if args.update_ranges_baseline:
+        prior = engine.load_ranges_baseline(baseline_path)
+        snapshot = dict(prior)
+        snapshot.update(report.snapshot)
+        for name in report.stale_baseline:
+            snapshot.pop(name, None)
+        engine.write_ranges_baseline(baseline_path, snapshot)
+        print(f"ranges-baseline: wrote {len(snapshot)} contract(s) to "
+              f"{baseline_path}")
+        # the refresh clears only the snapshot-drift family (CSA1404);
+        # proved overflows, unprovable ops and missing invariants
+        # survive it — report them NOW, not on the next CI run
+        remaining = [f for f in report.findings if f.rule != "CSA1404"]
+        if remaining:
+            from .core import RULES
+            print("ranges-baseline: the refresh does NOT clear these "
+                  "(fix the kernel or change its contract):")
+            for f in remaining:
+                print(f"{f.path}:{f.line}: [{f.rule}] "
+                      f"{RULES[f.rule].severity}: {f.context}: {f.message}")
         report.findings = remaining
     else:
         print(engine.render_human(report))

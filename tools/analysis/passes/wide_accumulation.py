@@ -16,9 +16,16 @@ Simple per-function AST dataflow: a name assigned from
 yields a fresh weight-0 value, which is how the interposed carry round
 clears the taint. Flagged: an Add/Sub accumulation whose total raw-wide
 weight exceeds 2, or a raw-wide value handed to an
-`_apply_int_matrix`-shaped callee. Notice severity: a site may still be
-in budget for other reasons (smaller operand bounds) — suppress with a
-justification if so.
+`_apply_int_matrix`-shaped callee.
+
+Since the value-range tier landed (tools/analysis/ranges/, CSA1401—
+`make ranges`), this pass is the fast syntactic PRE-CHECK, not the
+authority: the interval interpreter proves the same budget on the real
+traced values. A function that a module's RANGE_CONTRACTS section
+references is therefore skipped here — the proving tier owns it and
+double-reporting the same accumulation in out/analysis.json would be
+noise; everywhere else the notice survives as the cheap early warning
+(it runs on the no-jax lint lane where the prover cannot).
 """
 from __future__ import annotations
 
@@ -28,11 +35,14 @@ from ..core import Finding, register_pass, register_rule
 
 register_rule(
     "CSA901",
-    "fq_mul_wide columns accumulated >2 deep with no wide carry round",
+    "fq_mul_wide columns accumulated >2 deep with no wide carry round "
+    "(syntactic pre-check of the CSA1401 range proof)",
     "notice",
     "raw wide columns reach 14*2^58; interpose fq_wide_norm (a value-"
     "preserving wide carry round) before summing more than two or before "
-    "any _apply_int_matrix combination",
+    "any _apply_int_matrix combination — or cover the site with a "
+    "RANGE_CONTRACTS entry and let `make ranges` (CSA1401) prove the "
+    "budget on the real traced values",
 )
 
 _WIDE_SOURCES = ("fq_mul_wide",)
@@ -78,7 +88,9 @@ class _FnScanner:
         self.findings.append(Finding(
             "CSA901", self.mod.path, lineno,
             f"accumulation of {w} raw fq_mul_wide terms with no interposed "
-            f"wide carry round (int64 columns overflow beyond 2 terms)",
+            f"wide carry round (int64 columns overflow beyond 2 terms); "
+            f"the proving check is the CSA1401 range contract "
+            f"(`make ranges`)",
             context=self.mod.qualname(self.fn)))
 
     def check_expr(self, node, lineno):
@@ -126,11 +138,48 @@ class _FnScanner:
                 self.run_stmts(stmt.body)
 
 
+def _range_covered_names(mod) -> set:
+    """Function names the module's RANGE_CONTRACTS registry references,
+    transitively through its builder helpers — those accumulations are
+    owned by the proving tier (CSA1401), so the syntactic pre-check
+    stays quiet there (no double-reporting in out/analysis.json). AST
+    scope, not textual: a docstring mentioning the word must not exempt
+    the whole module."""
+    fns = {n.name: n for n in mod.tree.body
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    seeds = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "RANGE_CONTRACTS"
+                for t in node.targets):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    seeds.add(sub.id)
+                elif isinstance(sub, ast.Attribute):
+                    seeds.add(sub.attr)
+    covered: set = set()
+    work = [s for s in seeds if s in fns]
+    while work:
+        name = work.pop()
+        if name in covered:
+            continue
+        covered.add(name)
+        for sub in ast.walk(fns[name]):
+            ref = sub.id if isinstance(sub, ast.Name) else (
+                sub.attr if isinstance(sub, ast.Attribute) else None)
+            if ref in fns and ref not in covered:
+                work.append(ref)
+    return covered
+
+
 @register_pass
 def run(mod):
     findings = []
+    covered = _range_covered_names(mod)
     for node in ast.walk(mod.tree):
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in covered:
             continue
         scanner = _FnScanner(mod, node)
         scanner.run_stmts(node.body)

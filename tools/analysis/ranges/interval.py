@@ -1,0 +1,213 @@
+"""Exact integer/float interval arithmetic — the abstract domain of the
+value-range tier.
+
+Intervals carry arbitrary-precision Python ints (floats only for float
+dtypes), so a bound like `14 * 2^58` is exact, never a rounded double.
+Every transfer function here is the true mathematical image of the
+concrete op over the interval box (for the nonlinear ones, the min/max
+over the corner combinations, which is exact for monotone-per-argument
+ops like mul/div on fixed signs); WRAPPING is not modeled here — the
+interpreter (interp.py) compares the ideal-arithmetic result against
+the dtype bounds and decides whether a wrap is possible.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Interval:
+    lo: object   # int (or float for float dtypes; may be +-inf)
+    hi: object
+
+    def __post_init__(self):
+        assert self.lo <= self.hi, (self.lo, self.hi)
+
+    @property
+    def singleton(self):
+        return self.lo == self.hi
+
+    def __contains__(self, x):
+        return self.lo <= x <= self.hi
+
+    def within(self, other: "Interval") -> bool:
+        return other.lo <= self.lo and self.hi <= other.hi
+
+
+def iv(lo, hi=None) -> Interval:
+    return Interval(lo, lo if hi is None else hi)
+
+
+def _mk(lo, hi) -> Interval:
+    """Order-and-sanitize constructor for arithmetic results: float NaN
+    (inf * 0 and friends) degrades to the infinite interval instead of
+    poisoning comparisons."""
+    if isinstance(lo, float) and math.isnan(lo):
+        lo = float("-inf")
+    if isinstance(hi, float) and math.isnan(hi):
+        hi = float("inf")
+    if lo > hi:
+        lo, hi = hi, lo
+    return Interval(lo, hi)
+
+
+def join(a: Interval, b: Interval) -> Interval:
+    return _mk(min(a.lo, b.lo), max(a.hi, b.hi))
+
+
+def join_all(ivs) -> Interval:
+    ivs = list(ivs)
+    return _mk(min(i.lo for i in ivs), max(i.hi for i in ivs))
+
+
+def add(a, b):
+    return _mk(a.lo + b.lo, a.hi + b.hi)
+
+
+def sub(a, b):
+    return _mk(a.lo - b.hi, a.hi - b.lo)
+
+
+def neg(a):
+    return _mk(-a.hi, -a.lo)
+
+
+def mul(a, b):
+    cs = [x * y for x in (a.lo, a.hi) for y in (b.lo, b.hi)]
+    if any(isinstance(c, float) and math.isnan(c) for c in cs):
+        return Interval(float("-inf"), float("inf"))
+    return _mk(min(cs), max(cs))
+
+
+def scale(a: Interval, n: int) -> Interval:
+    """n summed copies of a value in `a` (reduce_sum over n elements)."""
+    if n <= 0:
+        return iv(0)
+    return Interval(min(a.lo, n * a.lo), max(a.hi, n * a.hi))
+
+
+def floordiv(a, b):
+    """Floor division; caller guarantees 0 not in b. Covers both python
+    floor and C trunc-toward-zero semantics (XLA integer div truncates)
+    by taking the hull of the two roundings at every corner."""
+    outs = []
+    for x in (a.lo, a.hi):
+        for d in (b.lo, b.hi):
+            if isinstance(x, float) or isinstance(d, float):
+                if d == 0:
+                    outs.extend([float("-inf"), float("inf")])
+                else:
+                    outs.append(x / d)
+                continue
+            outs.append(x // d)                  # floor
+            outs.append(-((-x) // d) if (x < 0) != (d < 0) else x // d)  # trunc
+    return _mk(min(outs), max(outs))
+
+
+def rem(a, b):
+    """a % b with 0 < b (unsigned/remainder-of-nonneg case); the sign of
+    a C-style remainder follows the dividend."""
+    m = b.hi - 1
+    if a.lo >= 0:
+        return Interval(0, min(a.hi, m))
+    return Interval(max(a.lo, -m), min(max(a.hi, 0), m))
+
+
+def shl(a, s):
+    cs = (a.lo << s.lo, a.lo << s.hi, a.hi << s.lo, a.hi << s.hi)
+    return Interval(min(cs), max(cs))
+
+
+def ashr(a, s):
+    """Arithmetic right shift (python >> is arithmetic/floor)."""
+    cs = (a.lo >> s.lo, a.lo >> s.hi, a.hi >> s.lo, a.hi >> s.hi)
+    return Interval(min(cs), max(cs))
+
+
+def and_(a, b):
+    """Bitwise and. Precise only for the mask idiom (one side nonneg):
+    x & m with m >= 0 lands in [0, m] regardless of x's sign (two's
+    complement). Fully-signed case falls back to the caller's dtype
+    widening (return None)."""
+    if a.lo >= 0 and b.lo >= 0:
+        return Interval(0, min(a.hi, b.hi))
+    if b.lo >= 0:
+        return Interval(0, b.hi)
+    if a.lo >= 0:
+        return Interval(0, a.hi)
+    return None
+
+
+def _pow2_ceil(x: int) -> int:
+    return 1 << max(x, 1).bit_length()
+
+
+def or_xor(a, b):
+    """Bitwise or/xor share a bound: both operands nonneg -> result in
+    [0, 2^ceil(log2(max+1)) - 1]. Signed case -> None (dtype range)."""
+    if a.lo >= 0 and b.lo >= 0:
+        return Interval(0, _pow2_ceil(max(a.hi, b.hi)) - 1)
+    return None
+
+
+def not_(a):
+    return Interval(-1 - a.hi, -1 - a.lo)
+
+
+def min_(a, b):
+    return Interval(min(a.lo, b.lo), min(a.hi, b.hi))
+
+
+def max_(a, b):
+    return Interval(max(a.lo, b.lo), max(a.hi, b.hi))
+
+
+def abs_(a):
+    if a.lo >= 0:
+        return a
+    if a.hi <= 0:
+        return Interval(-a.hi, -a.lo)
+    return Interval(0, max(-a.lo, a.hi))
+
+
+def sqrt(a):
+    lo = math.sqrt(a.lo) if a.lo > 0 else 0.0
+    hi = math.sqrt(a.hi) if a.hi > 0 else 0.0
+    return Interval(lo, hi)
+
+
+def isqrt(a):
+    """Exact integer square root image (clamped at 0 below)."""
+    return Interval(math.isqrt(max(a.lo, 0)), math.isqrt(max(a.hi, 0)))
+
+
+BOOL = Interval(0, 1)
+TRUE = Interval(1, 1)
+FALSE = Interval(0, 0)
+
+
+# ---------------------------------------------------------------------------
+# Dtype ranges
+# ---------------------------------------------------------------------------
+
+_INT_RANGES = {}
+for _bits in (8, 16, 32, 64):
+    _INT_RANGES[f"int{_bits}"] = Interval(-(1 << (_bits - 1)),
+                                          (1 << (_bits - 1)) - 1)
+    _INT_RANGES[f"uint{_bits}"] = Interval(0, (1 << _bits) - 1)
+_INT_RANGES["bool"] = BOOL
+
+
+def dtype_range(dtype) -> Interval:
+    """Representable range of a dtype; floats get the infinite interval
+    (they saturate, never wrap — overflow discipline is ints-only)."""
+    name = str(dtype)
+    r = _INT_RANGES.get(name)
+    if r is None:
+        return Interval(float("-inf"), float("inf"))
+    return r
+
+
+def is_int_dtype(dtype) -> bool:
+    return str(dtype) in _INT_RANGES and str(dtype) != "bool"
