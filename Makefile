@@ -8,7 +8,7 @@ PYTHON ?= python
 VECTOR_DIR ?= out/vectors
 JUNIT ?= out/test-results.xml
 
-.PHONY: test testall citest citest-cov citest-mainnet lint analyze contracts ranges vectors vectors-minimal bench bench-cpu multichip telemetry smoke clean
+.PHONY: test testall citest citest-cov citest-mainnet lint analyze contracts ranges vectors vectors-minimal bench bench-cpu multichip telemetry chaos smoke clean
 
 # measured 90.64% on the round-5 full suite; floor set just under so real
 # regressions fail while normal drift doesn't
@@ -124,6 +124,16 @@ multichip:
 telemetry:
 	$(PYTHON) tools/telemetry_smoke.py
 
+# Chaos drill (tools/chaos_drill.py): the resident serving loop driven
+# through a seeded fault schedule — transient raises, a poisoned output
+# (tripwired against the proven RANGE_CONTRACTS hulls), a hang past the
+# armed deadline, a full degradation-ladder walk down to single-device,
+# a corrupt checkpoint generation, and a kill mid-write — asserting the
+# final state is BIT-IDENTICAL to the fault-free run with zero residual
+# watchdog events. Artifact: out/chaos.json (CI uploads it).
+chaos:
+	$(PYTHON) tools/chaos_drill.py
+
 # Quick health check: lint + static analysis (all three tiers) + the
 # fast test modules. `make contracts` and `make ranges` ride here so an
 # op-budget or value-range regression fails at smoke time, before any
@@ -136,7 +146,7 @@ smoke:
 		--reference-root $(REFERENCE_ROOT)
 	$(MAKE) contracts
 	$(MAKE) ranges
-	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_trace_contracts.py tests/test_range_contracts.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py tests/test_telemetry.py -q -m "not slow"
+	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_trace_contracts.py tests/test_range_contracts.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py tests/test_telemetry.py tests/test_resilience.py tests/test_chaos_checkpoint.py -q -m "not slow"
 
 clean:
 	rm -rf out .pytest_cache $(VECTOR_DIR)

@@ -259,6 +259,17 @@ class BeaconNodeAPI:
         from .. import telemetry
         return telemetry.chrome_trace()
 
+    def get_healthz(self) -> dict:
+        """GET /healthz: the resilience view — current degradation-ladder
+        rung, retry/deadline-miss/fault/corruption counters, and the
+        last good checkpoint generation (resilience.health_snapshot).
+        Served even while syncing AND while degraded: a node that stops
+        answering /healthz exactly when it limps is a node an operator
+        cannot triage. Counters are `always=True` metrics, so the body
+        stays truthful under CSTPU_TELEMETRY=0."""
+        from .. import resilience
+        return resilience.health_snapshot()
+
     # -----------------------------------------------------------------------
 
     def _reject_if_syncing(self) -> None:

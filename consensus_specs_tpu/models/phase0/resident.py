@@ -58,6 +58,8 @@ import numpy as np
 import jax
 
 from ... import telemetry
+from ...resilience.errors import (CheckpointCorrupt, DispatchError,
+                                  FatalDispatchError)
 from ...telemetry import watchdog as _watchdog
 from ...utils.ssz import bulk
 from ...utils.ssz import impl as ssz_impl
@@ -185,14 +187,43 @@ class ResidentCore:
 
         A light-resident core drives slots and epoch boundaries; full
         block processing and exit() need the object registry and are the
-        standard entry's job."""
+        standard entry's job.
+
+        Truncated or garbage bytes raise the TYPED `CheckpointCorrupt`
+        (resilience/errors.py) up front — never an opaque struct/index
+        error from deep inside the offset-grammar walkers — so the
+        checkpoint store's generation fallback can branch on type."""
         if spec._insert_after_registry_updates or spec._insert_after_final_updates:
             raise NotImplementedError(
                 "resident mode covers the phase-0 fused epoch program; "
                 "phase-1 insert hooks take process_epoch_soa_staged")
         from ...utils.ssz.columns import state_columns_from_bytes
-        np_cols = state_columns_from_bytes(state_bytes, spec)
-        state = light_state_from_bytes(spec, state_bytes)
+        from ...utils.ssz.impl import fixed_byte_size, is_fixed_size
+        if not isinstance(state_bytes, (bytes, bytearray, memoryview)):
+            raise CheckpointCorrupt(
+                f"checkpoint payload must be bytes, got "
+                f"{type(state_bytes).__name__}")
+        # length floor BEFORE any parsing: every fixed field plus one
+        # 4-byte offset per variable field must fit
+        floor = sum(
+            fixed_byte_size(t) if is_fixed_size(t) else 4
+            for t in spec.BeaconState.get_field_types())
+        if len(state_bytes) < floor:
+            raise CheckpointCorrupt(
+                f"checkpoint truncated: {len(state_bytes)} bytes < the "
+                f"{floor}-byte BeaconState fixed-part floor")
+        try:
+            np_cols = state_columns_from_bytes(state_bytes, spec)
+            state = light_state_from_bytes(spec, state_bytes)
+        except CheckpointCorrupt:
+            raise
+        except Exception as exc:
+            # the SSZ walkers reject garbage with Assertion/Index/Value/
+            # struct errors at whatever depth the framing first breaks;
+            # surface ONE typed class with the cause chained
+            raise CheckpointCorrupt(
+                f"checkpoint bytes do not parse as a serialized "
+                f"BeaconState: {type(exc).__name__}: {exc}") from exc
         core = cls.__new__(cls)
         core._mesh = _serving_mesh(mesh)
         core._tkey = f"resident{next(_CORE_SEQ)}"
@@ -695,6 +726,110 @@ class ResidentCore:
         state.latest_block_roots[state.slot % spec.SLOTS_PER_HISTORICAL_ROOT] = \
             spec.signing_root(state.latest_block_header)
 
+    def degrade_to_single_device(self) -> None:
+        """The degradation ladder's bottom rung (resilience/dispatch.py):
+        abandon the serving mesh and re-enter single-device — one
+        download of the logical columns, unsharded re-upload, forests
+        invalidated (the next root request rebuilds them unsharded).
+        Deliberate and reported, so the chained-column watchdog keys are
+        forgotten rather than tripped: the re-placement IS the recovery
+        action, not a bug. Bit-identity is PR 6's committed
+        sharded==single gate. Idempotent when already single-device."""
+        if self._mesh is None:
+            return
+        import jax.numpy as jnp
+        with telemetry.span("resident.degrade_single_device"):
+            np_cols = self._materialize_np_cols()
+            self._mesh = None
+            self.cols = ValidatorColumns(
+                **{f: jnp.asarray(np_cols[f]) for f in _ALL_FIELDS})
+            self.pk_dev = jnp.asarray(self._pk_np)
+            self.wc_dev = jnp.asarray(self._wc_np)
+            self._reg_forest = None
+            self._bal_forest = None
+            self._big_roots = None
+            for key in (f"{self._tkey}.epoch.cols",
+                        f"{self._tkey}.forest.reg.l0",
+                        f"{self._tkey}.forest.bal.l0"):
+                _watchdog.forget(key)
+
+    def _epoch_dispatch(self, scal, inp):
+        """The guarded boundary dispatch + the degradation ladder.
+
+        `inp` arrives UNPADDED ([V] facts); padding to the mesh multiple
+        happens per attempt, because a ladder walk can end at the
+        single-device rung (`degrade_to_single_device`) where the padded
+        shape no longer applies. The inner guard (guarded_dispatch, via
+        ServingMesh.epoch_transition on the mesh path) owns retry/
+        backoff/deadline/tripwires; this loop owns only the LADDER: each
+        typed failure that survives its retries steps one rung — oracle
+        knobs first, sharded→single last — and re-dispatches. Raises
+        FatalDispatchError when the ladder is exhausted."""
+        from ...resilience import dispatch as _rdispatch
+        from ...resilience.integrity import (epoch_output_check,
+                                             tripwires_enabled)
+        check = epoch_output_check if tripwires_enabled() else None
+        ladder = _rdispatch.ladder()
+        while True:
+            try:
+                if self._mesh is not None:
+                    # matched in/out shardings: this boundary's output
+                    # columns are the next boundary's inputs, zero re-layout
+                    inp_p = pad_epoch_inputs(
+                        inp, int(self.cols.balance.shape[0]))
+                    return self._mesh.epoch_transition(
+                        self.cfg, self.cols, scal, inp_p, check=check)
+                # _epoch_transition_jit() donates off-CPU exactly like
+                # the mesh program: same no-retry pin for post-consume
+                # failures (pre-dispatch transients still retry inside
+                # the guard — it tracks whether fn ever ran)
+                donate = jax.default_backend() != "cpu"
+                return _rdispatch.guarded_dispatch(
+                    (self._tkey, "epoch", int(self.cols.balance.shape[0])),
+                    _epoch_transition_jit(), self.cfg, self.cols, scal, inp,
+                    check=check,
+                    retries=0 if donate else _rdispatch.RETRIES_DEFAULT)
+            except FatalDispatchError:
+                raise
+            except DispatchError as exc:
+                # branch on the guard's RECORDED fact, not the exception
+                # type: a transient raised DURING execution consumed the
+                # donated buffers just as surely as a deadline miss did
+                if (jax.default_backend() != "cpu"
+                        and getattr(exc, "consumed_inputs", True)):
+                    # donating backend + a failure observed AFTER the
+                    # dispatch consumed the resident column buffers
+                    # (deadline miss, tripwired output) — mesh-sharded
+                    # or single-device alike: the arrays are gone, so
+                    # in-memory recovery (including the single-device
+                    # rung's materialize) is impossible — the recovery
+                    # grain is the checkpoint store. Pre-dispatch
+                    # transients keep their buffers and still walk the
+                    # ladder below.
+                    raise FatalDispatchError(
+                        f"epoch dispatch failed after consuming donated "
+                        f"column buffers ({exc}); restore via "
+                        f"resilience.CheckpointStore.restore",
+                        key=exc.key, attempts=exc.attempts) from exc
+                # the ladder is GLOBAL serving-loop conservatism: rungs
+                # 1-3 swap oracle kernels this particular program never
+                # calls (they matter for the forest/pairing dispatch
+                # sites), so for an epoch failure they are quick no-op
+                # hops on the way to the rung that can help
+                # (single_device) — the price of one simple invariant,
+                # rung k == knobs 1..k, that /healthz can report
+                ladder.register_single_device(self.degrade_to_single_device)
+                try:
+                    rung = ladder.degrade(reason=type(exc).__name__)
+                finally:
+                    ladder.unregister_single_device(
+                        self.degrade_to_single_device)
+                if rung is None:
+                    raise FatalDispatchError(
+                        f"epoch boundary dispatch failed with the "
+                        f"degradation ladder exhausted: {exc}",
+                        key=exc.key, attempts=exc.attempts) from exc
+
     def process_epoch_resident(self, state) -> None:
         """The boundary transition on resident columns, under telemetry
         spans ("resident.stage" — host distillation off the mirrors,
@@ -718,10 +853,6 @@ class ResidentCore:
             process_crosslinks_vectorized(spec, state, ctx)
             inp = build_epoch_inputs(spec, state, ctx)
             scal = scalars_from_state(state)
-            if self._mesh is not None:
-                # pad the [V] facts to the columns' padded row count; the
-                # epoch jit's in_shardings place them on the mesh
-                inp = pad_epoch_inputs(inp, int(self.cols.balance.shape[0]))
             sp_stage.fence(scal, inp)   # uploads land in "resident.stage"
 
         with telemetry.span("resident.device") as sp_dev:
@@ -729,15 +860,7 @@ class ResidentCore:
             # fingerprints must match across boundaries (any in->out or
             # out->next-in placement change is a re-layout event)
             _watchdog.layout_check(f"{self._tkey}.epoch.cols", self.cols)
-            if self._mesh is not None:
-                # matched in/out shardings: this boundary's output columns
-                # are the next boundary's inputs with ZERO re-layout
-                dev_cols, dev_scal, dev_report = self._mesh.epoch_transition(
-                    self.cfg, self.cols, scal, inp)
-            else:
-                dev_cols, dev_scal, dev_report = _watchdog.dispatch(
-                    (self._tkey, "epoch", int(self.cols.balance.shape[0])),
-                    _epoch_transition_jit(), self.cfg, self.cols, scal, inp)
+            dev_cols, dev_scal, dev_report = self._epoch_dispatch(scal, inp)
             _watchdog.layout_check(f"{self._tkey}.epoch.cols", dev_cols)
             sp_dev.fence(dev_cols.balance)
 

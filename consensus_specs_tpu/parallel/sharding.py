@@ -21,14 +21,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.phase0.epoch_soa import (
     EpochInputs, EpochReport, EpochScalars, ValidatorColumns,
     _epoch_transition_traced)
-from ..telemetry import watchdog as _watchdog
+from ..resilience import faults as _faults
+from ..resilience.dispatch import RETRIES_DEFAULT, guarded_dispatch
 from ..utils.merkle import next_power_of_two
 
 
 def validator_mesh(devices=None, n: int = None) -> Mesh:
-    """A 1-D mesh over the validator axis ("v")."""
+    """A 1-D mesh over the validator axis ("v"). The ambient device list
+    routes through the fault harness's device-loss filter
+    (resilience/faults.py `mesh=lose:<k>`), so a simulated loss surfaces
+    here — at mesh construction — exactly like a real missing chip."""
     if devices is None:
-        devices = jax.devices()
+        devices = _faults.filter_devices(jax.devices())
     if n is not None:
         assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
         devices = devices[:n]
@@ -198,6 +202,24 @@ class ServingMesh:
         return cls(validator_mesh(n=n))
 
     @classmethod
+    def available(cls, max_n: int = None) -> Optional["ServingMesh"]:
+        """The largest power-of-two serving mesh the SURVIVING devices
+        support (the ambient list filtered through the fault harness's
+        device-loss hook) — the restore-after-hardware-loss entry: a
+        checkpoint written under 8 devices restores onto whatever is
+        left. None when fewer than 2 devices remain."""
+        devices = list(_faults.filter_devices(jax.devices()))
+        limit = len(devices) if max_n is None else min(len(devices), max_n)
+        n = 1
+        while n * 2 <= limit:
+            n *= 2
+        if n <= 1:
+            return None
+        # devices already filtered: pass them through so validator_mesh
+        # does not consume a second device-loss fault occurrence
+        return cls(validator_mesh(devices=devices, n=n))
+
+    @classmethod
     def from_env(cls) -> Optional["ServingMesh"]:
         """CSTPU_SERVING_MESH knob: unset/""/"0"/"off" -> single-device
         (None); "all" -> the largest power-of-two device count available;
@@ -208,9 +230,7 @@ class ServingMesh:
         if spec in ("", "0", "off", "none"):
             return None
         if spec == "all":
-            n = 1
-            while n * 2 <= len(jax.devices()):
-                n *= 2
+            return cls.available()
         else:
             try:
                 n = int(spec)
@@ -253,13 +273,20 @@ class ServingMesh:
                         shard_comm_balance=self.replicated),
         )
 
-    def epoch_transition(self, cfg, cols, scal, inp):
+    def epoch_transition(self, cfg, cols, scal, inp, check=None):
         """The fused epoch program with matched in/out shardings: sharded
         `[Vp]` columns in, sharded `[Vp]` columns out, so consecutive
         boundaries chain with zero re-layout. Donation is per shard on
         accelerator backends (each device's column shard is rewritten in
         place); XLA:CPU stays undonated for the same persistent-cache
-        aliasing reason as epoch_soa.epoch_transition_device."""
+        aliasing reason as epoch_soa.epoch_transition_device.
+
+        Dispatch goes through the resilience guard: with nothing armed
+        it degenerates to the watchdog-wrapped call; under a deadline
+        budget / fault schedule it gains retry + the typed taxonomy, and
+        `check` (resilience/integrity.py) tripwires the output before it
+        can chain (the caller decides how to degrade — ResidentCore
+        walks the ladder)."""
         donate = jax.default_backend() != "cpu"
         key = ("epoch", cfg, donate)
         fn = self._jits.get(key)
@@ -275,10 +302,16 @@ class ServingMesh:
             self._jits[key] = fn
         # retrace watchdog: the key pins the full static context (mesh
         # size, padded V, config), so any compile-cache miss after the
-        # first compile is a genuine retrace of the steady-state program
+        # first compile is a genuine retrace of the steady-state program.
+        # Donated programs must NOT retry: a failure observed after the
+        # dispatch consumed the per-shard column buffers would re-call fn
+        # on deleted arrays — the typed error surfaces on the FIRST
+        # attempt instead, and the caller recovers at a coarser grain
+        # (ResidentCore's ladder / checkpoint restore).
         wkey = ("mesh.epoch", self.size, int(cols.balance.shape[0]),
                 cfg, donate)
-        return _watchdog.dispatch(wkey, fn, cols, scal, inp)
+        return guarded_dispatch(wkey, fn, cols, scal, inp, check=check,
+                                retries=0 if donate else RETRIES_DEFAULT)
 
     # -- forest level-0 builders --------------------------------------------
 
@@ -314,7 +347,7 @@ class ServingMesh:
                 in_shardings=tuple([self.shard_v] * 8) + (self.replicated,),
                 out_shardings=self.row_sharding(p2))
             self._jits[key] = fn
-        return _watchdog.dispatch(
+        return guarded_dispatch(
             ("mesh.regleaves", self.size, vp, p2), fn,
             pubkeys, withdrawal_credentials,
             activation_eligibility_epoch, activation_epoch,
@@ -346,8 +379,8 @@ class ServingMesh:
             fn = jax.jit(traced, in_shardings=(self.shard_v,),
                          out_shardings=self.row_sharding(p2))
             self._jits[key] = fn
-        return _watchdog.dispatch(("mesh.balchunks", self.size, vp, p2),
-                                  fn, balances)
+        return guarded_dispatch(("mesh.balchunks", self.size, vp, p2),
+                                fn, balances)
 
     def forest_build_shardings(self, capacity: int):
         """(in_shardings, out_shardings) of the forest-build program at a
@@ -374,7 +407,7 @@ class ServingMesh:
                          in_shardings=in_sh, out_shardings=out_sh)
             self._jits[key] = fn
         wkey = ("mesh.forest_build", self.size, capacity)
-        return lambda leaves, _fn=fn: _watchdog.dispatch(wkey, _fn, leaves)
+        return lambda leaves, _fn=fn: guarded_dispatch(wkey, _fn, leaves)
 
 
 def trees_bitwise_equal(a, b) -> bool:

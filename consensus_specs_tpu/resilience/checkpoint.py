@@ -1,0 +1,288 @@
+"""Crash-safe generational checkpointing (ISSUE 13 tentpole (d)).
+
+Frame format (little-endian, 28-byte header):
+
+    magic    4s   b"CSTP"
+    version  u32  1
+    gen      u64  generation number (monotonic per store)
+    length   u64  payload byte count
+    crc      u32  zlib.crc32(payload)
+    payload  ...  serialized BeaconState bytes (ResidentCore.checkpoint_bytes)
+
+Write protocol — the classic atomic-rename dance, so a kill at ANY
+instant leaves the store with its previous good generations intact:
+
+    1. write the full frame to `<root>/.tmp-<gen>` and fsync it;
+    2. os.replace onto `<root>/state-<gen>.ckpt` (atomic on POSIX);
+    3. fsync the directory so the rename itself is durable;
+    4. prune generations beyond `keep`.
+
+Read protocol — `load()` walks generations NEWEST first, validating
+magic/version/length/CRC; a corrupt generation is counted
+(`resilience.checkpoint.corrupt_generations`), logged, and SKIPPED, so
+`restore()` falls back to the previous good generation instead of dying
+on a truncated or bit-flipped file. The payload is mesh-agnostic
+(logical state bytes, no placement), so a checkpoint taken under an
+8-device serving mesh restores under 2 devices, 1 device, or a mesh
+that lost hardware since the save — the restore-across-mesh-change
+drill of ROADMAP item 4.
+
+Fault hooks: writes route through `faults.on_checkpoint_write` (silent
+truncate/bitflip corruption, or `crash` = partial write + SimulatedCrash
+with NO rename — the kill-mid-write drill), reads through
+`faults.on_checkpoint_read`.
+"""
+from __future__ import annotations
+
+import os
+import re
+import struct
+import zlib
+from typing import List, Optional, Tuple
+
+from . import faults
+from .errors import CheckpointCorrupt, SimulatedCrash
+
+MAGIC = b"CSTP"
+VERSION = 1
+_HEADER = struct.Struct("<4sIQQI")
+
+_NAME_RE = re.compile(r"^state-(\d{8})\.ckpt$")
+
+
+def frame(payload: bytes, generation: int) -> bytes:
+    return _HEADER.pack(MAGIC, VERSION, generation, len(payload),
+                        zlib.crc32(payload)) + payload
+
+
+def unframe(data: bytes, *, generation=None) -> Tuple[int, bytes]:
+    """Validate a frame -> (generation, payload); raises the typed
+    CheckpointCorrupt on any framing violation (truncation, bad magic,
+    length drift, CRC mismatch)."""
+    if len(data) < _HEADER.size:
+        raise CheckpointCorrupt(
+            f"checkpoint frame truncated: {len(data)} bytes < "
+            f"{_HEADER.size}-byte header", generation=generation)
+    magic, version, gen, length, crc = _HEADER.unpack_from(data)
+    if magic != MAGIC:
+        raise CheckpointCorrupt(f"bad checkpoint magic {magic!r}",
+                                generation=generation)
+    if version != VERSION:
+        raise CheckpointCorrupt(f"unsupported checkpoint version {version}",
+                                generation=generation)
+    payload = data[_HEADER.size:]
+    if len(payload) != length:
+        raise CheckpointCorrupt(
+            f"checkpoint payload truncated: header claims {length} bytes, "
+            f"found {len(payload)}", generation=generation)
+    if zlib.crc32(payload) != crc:
+        raise CheckpointCorrupt("checkpoint CRC mismatch (bit rot or a "
+                                "torn write)", generation=generation)
+    if generation is not None and gen != generation:
+        # the payload CRC cannot see header corruption: the gen field's
+        # integrity check is this cross-check against the filename the
+        # caller read the frame from
+        raise CheckpointCorrupt(
+            f"checkpoint header claims generation {gen} but was read "
+            f"from generation {generation}'s file (header bit rot)",
+            generation=generation)
+    return gen, payload
+
+
+def _last_good_gauge():
+    from .. import telemetry
+    return telemetry.gauge("resilience.checkpoint.generation", always=True)
+
+
+class CheckpointStore:
+    """A directory of CRC-framed generations with atomic-rename writes
+    and corruption fallback on read."""
+
+    def __init__(self, root: str, keep: int = 4):
+        assert keep >= 1, keep
+        self.root = str(root)
+        self.keep = keep
+        # generations already counted corrupt by this store's walks: the
+        # /healthz counter tallies DISTINCT corrupt generations, not how
+        # many times a triaging operator re-walked past the same one
+        self._corrupt_counted = set()
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- paths / listing ------------------------------------------------
+
+    def path(self, generation: int) -> str:
+        return os.path.join(self.root, f"state-{generation:08d}.ckpt")
+
+    def generations(self) -> List[int]:
+        """Committed generations, ascending (temp files never listed —
+        a crash mid-write leaves only `.tmp-*`, which is garbage by
+        construction)."""
+        gens = []
+        for name in os.listdir(self.root):
+            m = _NAME_RE.match(name)
+            if m:
+                gens.append(int(m.group(1)))
+        return sorted(gens)
+
+    def latest_generation(self) -> Optional[int]:
+        gens = self.generations()
+        return gens[-1] if gens else None
+
+    # -- write ----------------------------------------------------------
+
+    def save(self, payload: bytes, generation: Optional[int] = None) -> int:
+        """Frame + atomically commit `payload` as the next generation.
+        Returns the generation number. A `crash` fault writes a partial
+        temp file and raises SimulatedCrash BEFORE the rename — the
+        committed generations are untouched, exactly like a real kill."""
+        from .. import telemetry
+        gen = generation if generation is not None \
+            else (self.latest_generation() or 0) + 1
+        data = frame(payload, gen)
+        data_out, crash = faults.on_checkpoint_write(data)
+        tmp = os.path.join(self.root, f".tmp-{gen:08d}")
+        with telemetry.span("resilience.checkpoint.save", generation=gen):
+            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            try:
+                # os.write may write SHORT (single-syscall caps near
+                # 2 GiB — a 10M-validator state payload crosses them):
+                # loop until every byte lands, in the one module whose
+                # job is durable persistence
+                view = memoryview(data_out)
+                while view:
+                    view = view[os.write(fd, view):]
+                if crash:
+                    # a kill flushes nothing deliberately: close without
+                    # fsync, never rename
+                    raise SimulatedCrash(
+                        f"injected kill mid-write of generation {gen} "
+                        f"({len(data_out)}/{len(data)} bytes hit disk)")
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            os.replace(tmp, self.path(gen))
+            dirfd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+        telemetry.counter("resilience.checkpoint.saves", always=True).inc()
+        # last_good is a VALIDATED claim, not a write claim: the bytes
+        # that went to disk (post write-fault mutation) must frame-check
+        # before /healthz may advertise the generation as restorable.
+        # Validated IN MEMORY — data_out is exactly what was written, so
+        # re-reading a multi-GB payload back per save would only double
+        # checkpoint I/O; genuine at-rest media rot is load()'s CRC walk
+        # and _prune's rescue probe to catch.
+        try:
+            unframe(bytes(data_out), generation=gen)
+            ok = True
+        except CheckpointCorrupt:
+            ok = False
+        if ok:
+            _last_good_gauge().set(gen)
+        self._prune(known={gen: ok})    # reuse the verdict
+        return gen
+
+    def _prune(self, known: Optional[dict] = None) -> None:
+        """Drop generations beyond `keep` — but NEVER the newest one that
+        still validates: under persistent silent write corruption (the
+        modeled truncate/bitflip media fault) a purely count-based prune
+        would eventually evict the last good generation and leave the
+        store all-corrupt.
+
+        `known` caches {generation: validity} verdicts (save() passes
+        its read-back result), and the kept set probes NEWEST first, so
+        the steady-state save pays zero extra file reads here — the
+        just-committed generation short-circuits the scan."""
+        known = dict(known or {})
+
+        def valid(g: int) -> bool:
+            if g not in known:
+                known[g] = self._validates(g)
+            return known[g]
+
+        gens = self.generations()
+        doomed = gens[:-self.keep]
+        if not doomed:
+            return
+        if not any(valid(g) for g in reversed(gens[-self.keep:])):
+            for gen in reversed(doomed):
+                if valid(gen):
+                    doomed = [g for g in doomed if g != gen]
+                    break
+        for gen in doomed:
+            try:
+                os.remove(self.path(gen))
+            except OSError:
+                pass
+
+    def _validates(self, generation: int) -> bool:
+        """Frame-validity probe for prune decisions. Reads the raw file —
+        deliberately NOT through faults.on_checkpoint_read, which models
+        read-time corruption and must not have occurrences consumed by
+        housekeeping."""
+        try:
+            with open(self.path(generation), "rb") as f:
+                unframe(f.read(), generation=generation)
+            return True
+        except (OSError, CheckpointCorrupt):
+            return False
+
+    # -- read -----------------------------------------------------------
+
+    def load(self, generation: Optional[int] = None) -> Tuple[int, bytes]:
+        """-> (generation, payload) of the requested generation, or of
+        the NEWEST generation that validates. Corrupt generations are
+        counted and skipped; raises CheckpointCorrupt only when nothing
+        intact remains."""
+        from .. import telemetry
+        gens = ([generation] if generation is not None
+                else list(reversed(self.generations())))
+        last_exc: Optional[CheckpointCorrupt] = None
+        for gen in gens:
+            try:
+                with open(self.path(gen), "rb") as f:
+                    data = f.read()
+            except OSError as exc:
+                last_exc = CheckpointCorrupt(
+                    f"generation {gen} unreadable: {exc}", generation=gen)
+                continue
+            data = faults.on_checkpoint_read(data)
+            try:
+                file_gen, payload = unframe(data, generation=gen)
+            except CheckpointCorrupt as exc:
+                if gen not in self._corrupt_counted:
+                    self._corrupt_counted.add(gen)
+                    telemetry.counter(
+                        "resilience.checkpoint.corrupt_generations",
+                        always=True).inc()
+                last_exc = exc
+                continue
+            if generation is None:
+                # only the newest-first fallback walk advances the
+                # last-good gauge: an operator explicitly loading an
+                # OLDER generation for inspection must not regress what
+                # /healthz advertises as restorable
+                _last_good_gauge().set(gen)
+            return gen, payload
+        raise last_exc or CheckpointCorrupt(
+            f"no checkpoint generations in {self.root!r}")
+
+    def restore(self, spec, mesh="env", generation: Optional[int] = None):
+        """-> (generation, ResidentCore) resumed from the newest intact
+        generation — the checkpoint-failover entry: corrupt newest
+        generations fall back, and `mesh` may differ from the shape the
+        checkpoint was written under (the payload is logical bytes)."""
+        from ..models.phase0.resident import ResidentCore
+        gen, payload = self.load(generation)
+        return gen, ResidentCore.from_checkpoint(spec, payload, mesh=mesh)
+
+
+def last_good_generation() -> Optional[int]:
+    """The most recent generation any store in this process saved or
+    validated (what /healthz reports); None before the first."""
+    from .. import telemetry
+    snap_val = telemetry.gauge("resilience.checkpoint.generation",
+                               always=True).value
+    return int(snap_val) if snap_val else None

@@ -1,0 +1,365 @@
+"""Seeded, scriptable fault injection for the serving loop (ISSUE 13).
+
+`CSTPU_FAULTS=<schedule>` arms the harness; unset it is a zero-overhead
+no-op (one module-global read per query — the CSTPU_TELEMETRY=0 idiom,
+bound asserted in tests/test_resilience.py). Faults inject at the seams
+the serving loop already has:
+
+  * **dispatch** — resilience/dispatch.py consults `on_dispatch(key)`
+    around every guarded program launch (the same keys the
+    telemetry.watchdog retrace counter uses);
+  * **checkpoint I/O** — resilience/checkpoint.py routes every framed
+    write through `on_checkpoint_write` and every read through
+    `on_checkpoint_read`;
+  * **mesh construction** — parallel/sharding.py filters its device
+    list through `filter_devices` (simulated device loss).
+
+Schedule grammar (`;`-separated entries):
+
+    seed=<int>                         RNG seed for randomized mutations
+    <site>@<n>=<action>[:<param>]      fire on the n-th matching call
+    <site>@<a>-<b>=<action>[:<param>]  fire on matching calls a..b
+
+`<n>` counts matching invocations from 1; `@<a>-<b>` is an inclusive
+range (`@1-99` ~ "every call until recovery changes the key"). Sites:
+
+    dispatch[:<glob>]   fnmatch glob over str(key); default `*`
+    ckpt.write          the framed checkpoint bytes about to be written
+    ckpt.read           the framed checkpoint bytes just read
+    mesh                the device list a mesh is being built from
+
+Actions by site:
+
+    dispatch:   raise             transient XLA-style error pre-dispatch
+                fatal             non-retryable error pre-dispatch
+                hang:<ms>         wedge the dispatch for <ms> (deadline food)
+                poison[:<leaf>]   corrupt output leaf (NaN for floats,
+                                  dtype-max for ints; default leaf 0)
+    ckpt.write: truncate:<k>      drop the last <k> bytes (silent media error:
+                                  the write still completes "successfully")
+                bitflip[:<i>]     flip one bit (byte <i>, or seeded-random)
+                crash[:<frac>]    write only <frac> of the bytes, then raise
+                                  SimulatedCrash (kill mid-write: no rename)
+    ckpt.read:  truncate:<k> / bitflip[:<i>]   same mutations, read side
+    mesh:       lose:<k>          drop the last <k> devices
+
+Example — the chaos drill's flavor of a bad day:
+
+    CSTPU_FAULTS="seed=7;dispatch:*mesh.epoch*@1=raise;\
+dispatch:*mesh.epoch*@2=poison:6;dispatch:*mesh.epoch*@3=hang:400;\
+ckpt.write@2=truncate:33"
+
+Every injected fault increments `resilience.faults_injected` plus a
+per-action counter (`resilience.faults.raise`, ...) — `always=True`
+metrics, so the accounting survives CSTPU_TELEMETRY=0 (you want the
+fault log most exactly when everything else is degraded).
+
+Tests pin schedules in-process via `set_schedule(text)` / `set_schedule
+(None)` (returns control to the environment variable), mirroring
+telemetry.set_enabled.
+"""
+from __future__ import annotations
+
+import fnmatch
+import os
+import random
+import threading
+from typing import List, Optional, Tuple
+
+from .errors import InjectedFault, SimulatedCrash
+
+_UNSET = object()
+_lock = threading.Lock()
+
+_override = _UNSET          # set_schedule() pin; _UNSET = env-controlled
+_cached_env: object = _UNSET    # last CSTPU_FAULTS text parsed
+_cached_sched: Optional["_Schedule"] = None
+
+
+class Fault:
+    """One armed injection: `(action, param)` plus its source entry."""
+
+    __slots__ = ("action", "param", "entry")
+
+    def __init__(self, action: str, param, entry: str):
+        self.action = action
+        self.param = param
+        self.entry = entry
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"Fault({self.entry!r})"
+
+
+class _Entry:
+    __slots__ = ("site", "glob", "lo", "hi", "action", "param",
+                 "matches", "text")
+
+    def __init__(self, site, glob, lo, hi, action, param, text):
+        self.site = site
+        self.glob = glob
+        self.lo = lo
+        self.hi = hi
+        self.action = action
+        self.param = param
+        self.matches = 0        # matching invocations seen so far
+        self.text = text
+
+
+class _Schedule:
+    def __init__(self, entries: List[_Entry], seed: int):
+        self.entries = entries
+        self.seed = seed
+        self._rng = random.Random(seed)
+
+    def rng(self) -> random.Random:
+        return self._rng
+
+    def query(self, site: str, match_text: str = "") -> Optional[Fault]:
+        """The n-th matching call fires the entry armed for n (first hit
+        wins when several entries cover the same call)."""
+        fired = None
+        with _lock:
+            for e in self.entries:
+                if e.site != site:
+                    continue
+                if e.glob is not None and not fnmatch.fnmatch(match_text,
+                                                              e.glob):
+                    continue
+                e.matches += 1
+                if fired is None and e.lo <= e.matches <= e.hi:
+                    fired = Fault(e.action, e.param, e.text)
+        return fired
+
+
+_SITES = ("dispatch", "ckpt.write", "ckpt.read", "mesh")
+_ACTIONS = {
+    "dispatch": ("raise", "fatal", "hang", "poison"),
+    "ckpt.write": ("truncate", "bitflip", "crash"),
+    "ckpt.read": ("truncate", "bitflip"),
+    "mesh": ("lose",),
+}
+
+
+def parse_schedule(text: str) -> _Schedule:
+    """Parse the grammar above; malformed schedules fail loudly at parse
+    time (a chaos drill that silently runs fault-free is worse than one
+    that refuses to start)."""
+    entries: List[_Entry] = []
+    seed = 0
+    for raw in text.split(";"):
+        part = raw.strip()
+        if not part:
+            continue
+        if part.startswith("seed="):
+            seed = int(part[5:])
+            continue
+        try:
+            lhs, rhs = part.split("=", 1)
+            site_occ, _, occ = lhs.rpartition("@")
+            site, _, glob = site_occ.partition(":")
+            site = site.strip()
+            if site not in _SITES:
+                raise ValueError(f"unknown site {site!r} "
+                                 f"(expected one of {_SITES})")
+            if glob and site != "dispatch":
+                raise ValueError(f"only dispatch takes a key glob, "
+                                 f"got {site!r}:{glob!r}")
+            if "-" in occ:
+                lo_s, hi_s = occ.split("-", 1)
+                lo, hi = int(lo_s), int(hi_s)
+            else:
+                lo = hi = int(occ)
+            if lo < 1 or hi < lo:
+                raise ValueError(f"bad occurrence range {occ!r}")
+            action, _, param = rhs.partition(":")
+            action = action.strip()
+            if action not in _ACTIONS[site]:
+                raise ValueError(
+                    f"action {action!r} invalid for site {site!r} "
+                    f"(expected one of {_ACTIONS[site]})")
+            entries.append(_Entry(
+                site, (glob or "*") if site == "dispatch" else None,
+                lo, hi, action, param or None, part))
+        except Exception as exc:
+            # every malformed shape — including a context-free int() or
+            # unpack error — surfaces naming the offending entry
+            raise ValueError(f"malformed CSTPU_FAULTS entry {part!r}: "
+                             f"{exc}") from exc
+    return _Schedule(entries, seed)
+
+
+# ---------------------------------------------------------------------------
+# Activation / lookup
+# ---------------------------------------------------------------------------
+
+def set_schedule(text: Optional[str]) -> None:
+    """Pin a schedule for this process (tests / the chaos drill); None
+    returns control to CSTPU_FAULTS. Occurrence counters reset on every
+    pin — each drill phase starts from a clean count — and unpinning
+    drops the env-parse cache too, so an env-armed schedule resumes
+    FRESH rather than with occurrences a pre-pin phase already spent."""
+    global _override, _cached_env, _cached_sched
+    _override = parse_schedule(text) if text is not None else _UNSET
+    _cached_env = _UNSET
+    _cached_sched = None
+
+
+def _current() -> Optional[_Schedule]:
+    global _cached_env, _cached_sched
+    if _override is not _UNSET:
+        return _override
+    env = os.environ.get("CSTPU_FAULTS")
+    if not env:
+        # drop the cache on disarm, so re-arming the SAME schedule text
+        # later parses fresh — occurrence counters are mutable state,
+        # and a re-armed drill must not inherit spent entries (a chaos
+        # run that silently injects nothing is the failure mode this
+        # module exists to avoid)
+        _cached_env = _UNSET
+        _cached_sched = None
+        return None
+    if env != _cached_env:
+        _cached_env = env
+        _cached_sched = parse_schedule(env)
+    return _cached_sched
+
+
+def active() -> bool:
+    """True when a fault schedule is armed (env or pinned)."""
+    return _current() is not None
+
+
+def _count(action: str) -> None:
+    from .. import telemetry
+    telemetry.counter("resilience.faults_injected", always=True).inc()
+    telemetry.counter(f"resilience.faults.{action}", always=True).inc()
+
+
+# ---------------------------------------------------------------------------
+# Injection sites
+# ---------------------------------------------------------------------------
+
+def on_dispatch(key) -> Optional[Fault]:
+    """Consulted by guarded_dispatch before each attempt. The returned
+    fault (if any) is ACTED ON by the guard — raise/hang/poison all need
+    the guard's cooperation; counting happens here."""
+    sched = _current()
+    if sched is None:
+        return None
+    fault = sched.query("dispatch", str(key))
+    if fault is not None:
+        _count(fault.action)
+    return fault
+
+
+def raise_injected(key, fault: Fault) -> None:
+    """Materialize a raise/fatal fault as the exception class the
+    classifier expects for that flavor."""
+    if fault.action == "raise":
+        raise InjectedFault(
+            f"INTERNAL: injected transient failure at {key!r} "
+            f"({fault.entry})")
+    raise InjectedFault(
+        f"INVALID_ARGUMENT: injected fatal failure at {key!r} "
+        f"({fault.entry})")
+
+
+def poison_tree(out, leaf_spec):
+    """Corrupt one output leaf: floats get NaN at [0], ints get dtype-max
+    (the out-of-hull limb resilience/integrity.py trips on). `leaf_spec`
+    is the flattened leaf index (default 0). Returns a NEW tree — the
+    original buffers are never mutated in place."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    idx = int(leaf_spec) if leaf_spec else 0
+    leaves, treedef = jax.tree_util.tree_flatten(out)
+    idx = min(idx, len(leaves) - 1)
+    leaf = leaves[idx]
+    dtype = np.dtype(leaf.dtype)
+    if dtype.kind == "f":
+        bad = jnp.asarray(float("nan"), dtype=dtype)
+    elif dtype.kind == "b":
+        bad = jnp.asarray(True)
+    else:
+        bad = jnp.asarray(np.iinfo(dtype).max, dtype=dtype)
+    flat = leaf.reshape(-1) if getattr(leaf, "ndim", 0) else leaf.reshape(1)
+    poisoned = flat.at[0].set(bad).reshape(leaf.shape)
+    # keep the placement: a poisoned SHARDED buffer must stay sharded or
+    # the re-layout watchdog would fire on the injection, not the bug
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None and hasattr(sharding, "mesh"):
+        poisoned = jax.device_put(poisoned, sharding)
+    leaves = list(leaves)
+    leaves[idx] = poisoned
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _mutate_bytes(data: bytes, fault: Fault, rng: random.Random) -> bytes:
+    if fault.action == "truncate":
+        k = int(fault.param or 1)
+        return data[:max(0, len(data) - k)]
+    if fault.action == "bitflip":
+        if not data:
+            return data
+        i = int(fault.param) if fault.param else rng.randrange(len(data))
+        i = min(i, len(data) - 1)
+        buf = bytearray(data)
+        buf[i] ^= 1 << rng.randrange(8)
+        return bytes(buf)
+    raise AssertionError(fault.action)
+
+
+def on_checkpoint_write(data: bytes) -> Tuple[bytes, bool]:
+    """-> (bytes to actually write, crash_mid_write). With a `crash`
+    fault the returned bytes are the PARTIAL prefix; the caller writes
+    them and must then raise SimulatedCrash without renaming (that is
+    `checkpoint.py`'s job — see `CheckpointStore.save`)."""
+    sched = _current()
+    if sched is None:
+        return data, False
+    fault = sched.query("ckpt.write")
+    if fault is None:
+        return data, False
+    _count(fault.action)
+    if fault.action == "crash":
+        frac = float(fault.param) if fault.param else 0.5
+        return data[:int(len(data) * frac)], True
+    return _mutate_bytes(data, fault, sched.rng()), False
+
+
+def on_checkpoint_read(data: bytes) -> bytes:
+    sched = _current()
+    if sched is None:
+        return data
+    fault = sched.query("ckpt.read")
+    if fault is None:
+        return data
+    _count(fault.action)
+    return _mutate_bytes(data, fault, sched.rng())
+
+
+def filter_devices(devices):
+    """Simulated device loss at mesh-construction time: a `mesh=lose:<k>`
+    fault drops the last k devices, CLAMPED to keep at least one (a
+    process with zero devices cannot express anything — total loss is a
+    process kill, which the checkpoint drill models separately). The
+    caller re-plans its mesh size from what is left — ServingMesh rounds
+    down to a power of two."""
+    sched = _current()
+    if sched is None:
+        return devices
+    fault = sched.query("mesh")
+    if fault is None:
+        return devices
+    _count(fault.action)
+    k = int(fault.param or 1)
+    kept = list(devices)[:max(1, len(devices) - k)]
+    return kept
+
+
+__all__ = ["Fault", "active", "set_schedule", "parse_schedule",
+           "on_dispatch", "raise_injected", "poison_tree",
+           "on_checkpoint_write", "on_checkpoint_read", "filter_devices",
+           "InjectedFault", "SimulatedCrash"]

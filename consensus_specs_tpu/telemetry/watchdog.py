@@ -169,6 +169,17 @@ def reset() -> None:
         _layouts.clear()
 
 
+def forget(key) -> None:
+    """Drop ONE key's warm-up/fingerprint state. For deliberate,
+    reported re-placements — the resilience ladder's sharded→
+    single-device rung re-places the chained columns on purpose, and the
+    next observation under the key must count as warm-up, not as a
+    steady-state re-layout event."""
+    with _lock:
+        _retrace.pop(key, None)
+        _layouts.pop(key, None)
+
+
 # ---------------------------------------------------------------------------
 # Global compile counter (optional, jax.monitoring-based)
 # ---------------------------------------------------------------------------

@@ -129,6 +129,27 @@ def test_syncing_node_returns_503():
     # /node/* stays available while syncing
     assert api.get_syncing().is_syncing is True
     assert api.get_version()
+    # /healthz and /metrics too: the operational surface must answer
+    # exactly when the node is limping (ISSUE 13 satellite)
+    assert "status" in api.get_healthz()
+    assert api.get_metrics() is not None
+
+
+def test_healthz_reflects_degradation(api):
+    from consensus_specs_tpu import resilience
+    snap = api.get_healthz()
+    assert snap["status"] in ("ok", "degraded")
+    assert snap["rung"]["name"] in resilience.DegradationLadder.RUNGS
+    assert set(snap["counters"]) >= {"retries", "deadline_misses",
+                                     "faults_injected", "degradations"}
+    resilience.ladder().degrade("test")
+    try:
+        degraded = api.get_healthz()
+        assert degraded["status"] == "degraded"
+        assert degraded["rung"]["index"] == 1
+    finally:
+        resilience.ladder().reset()
+    assert api.get_healthz()["rung"]["index"] == 0
 
 
 def test_duty_proposal_slot_covers_future_slots(api):

@@ -1,0 +1,312 @@
+"""Crash-safe checkpointing tests (ISSUE 13): CRC framing, atomic-rename
+generations, corruption fallback, kill-mid-write, the typed
+`CheckpointCorrupt` from `ResidentCore.from_checkpoint`, and restore
+across a changed serving-mesh shape / simulated device loss.
+"""
+import os
+
+import pytest
+
+from consensus_specs_tpu import resilience, telemetry
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.models import phase0
+from consensus_specs_tpu.models.phase0.resident import ResidentCore
+from consensus_specs_tpu.resilience import checkpoint as ckpt
+from consensus_specs_tpu.resilience import faults
+from consensus_specs_tpu.resilience.errors import (CheckpointCorrupt,
+                                                   SimulatedCrash)
+from consensus_specs_tpu.testing import factories
+from consensus_specs_tpu.utils.ssz.impl import serialize
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.set_schedule(None)
+    telemetry.reset()
+    yield
+    faults.set_schedule(None)
+    telemetry.reset()
+
+
+@pytest.fixture(scope="module")
+def spec():
+    bls.bls_active = False
+    s = phase0.get_spec("minimal")
+    s.clear_caches()
+    return s
+
+
+@pytest.fixture(scope="module")
+def state_bytes(spec):
+    state = factories.seed_genesis_state(spec, 4 * spec.SLOTS_PER_EPOCH)
+    factories.advance_slots(spec, state, 2)
+    return serialize(state, spec.BeaconState)
+
+
+# ---------------------------------------------------------------------------
+# Frame + store mechanics
+# ---------------------------------------------------------------------------
+
+def test_frame_round_trip_and_validation():
+    payload = b"state-bytes" * 99
+    data = ckpt.frame(payload, 7)
+    gen, back = ckpt.unframe(data)
+    assert (gen, back) == (7, payload)
+    with pytest.raises(CheckpointCorrupt):
+        ckpt.unframe(data[:10])                       # header truncated
+    with pytest.raises(CheckpointCorrupt):
+        ckpt.unframe(data[:-3])                       # payload truncated
+    with pytest.raises(CheckpointCorrupt):
+        ckpt.unframe(b"JUNK" + data[4:])              # bad magic
+    flipped = bytearray(data)
+    flipped[40] ^= 0x10                               # payload bit rot
+    with pytest.raises(CheckpointCorrupt):
+        ckpt.unframe(bytes(flipped))
+    # header gen field (bytes 8..15) is outside the payload CRC: the
+    # filename cross-check is its integrity cover
+    gen_rot = bytearray(data)
+    gen_rot[9] ^= 0x01
+    with pytest.raises(CheckpointCorrupt):
+        ckpt.unframe(bytes(gen_rot), generation=7)
+    # raw unframe without a filename context still returns the value
+    assert ckpt.unframe(bytes(gen_rot))[1] == payload
+
+
+def test_store_generations_save_load_prune(tmp_path):
+    st = ckpt.CheckpointStore(tmp_path, keep=3)
+    for i in range(5):
+        assert st.save(b"gen%d" % i) == i + 1
+    assert st.generations() == [3, 4, 5]              # pruned to keep
+    gen, payload = st.load()
+    assert (gen, payload) == (5, b"gen4")
+    gen, payload = st.load(generation=4)
+    assert (gen, payload) == (4, b"gen3")
+    # an explicit load of an OLDER generation (inspection) must not
+    # regress what /healthz advertises as the newest restorable one
+    assert ckpt.last_good_generation() == 5
+
+
+def test_store_falls_back_over_corrupt_generations(tmp_path):
+    st = ckpt.CheckpointStore(tmp_path, keep=4)
+    st.save(b"good-one")
+    faults.set_schedule("ckpt.write@1=truncate:9;ckpt.write@2=bitflip:40")
+    st.save(b"truncated-on-disk")
+    st.save(b"bitflipped-on-disk")
+    faults.set_schedule(None)
+    assert st.generations() == [1, 2, 3]              # all committed...
+    gen, payload = st.load()                          # ...two corrupt
+    assert (gen, payload) == (1, b"good-one")
+    assert telemetry.counter("resilience.checkpoint.corrupt_generations",
+                             always=True).value == 2
+
+
+def test_prune_never_evicts_the_last_good_generation(tmp_path):
+    """Persistent silent write corruption (every save after the first is
+    truncated on disk) must not let the count-based prune walk the one
+    good generation out of the store."""
+    st = ckpt.CheckpointStore(tmp_path, keep=2)
+    st.save(b"the-only-good-one")
+    faults.set_schedule("ckpt.write@1-99=truncate:15")
+    for i in range(5):
+        st.save(b"corrupt-%d" % i)
+    faults.set_schedule(None)
+    assert 1 in st.generations()          # survived five prune rounds
+    gen, payload = st.load()
+    assert (gen, payload) == (1, b"the-only-good-one")
+    # with a good NEWEST generation the prune is purely count-based again
+    st.save(b"fresh-good")
+    st.save(b"fresher-good")
+    assert 1 not in st.generations()
+
+
+def test_silently_corrupt_save_does_not_advance_last_good(tmp_path):
+    """last_good_generation is a read-back claim: a save whose bytes a
+    write fault corrupted on disk (the 'successful' silent media error)
+    must not advertise itself to /healthz as restorable."""
+    st = ckpt.CheckpointStore(tmp_path)
+    st.save(b"good")
+    assert ckpt.last_good_generation() == 1
+    faults.set_schedule("ckpt.write@1=truncate:9")
+    st.save(b"corrupt-on-disk")
+    faults.set_schedule(None)
+    assert ckpt.last_good_generation() == 1       # gen 2 never validates
+    assert st.load() == (1, b"good")
+
+
+def test_store_empty_and_all_corrupt_raise(tmp_path):
+    st = ckpt.CheckpointStore(tmp_path)
+    with pytest.raises(CheckpointCorrupt):
+        st.load()
+    faults.set_schedule("ckpt.write@1=truncate:999999")
+    st.save(b"doomed")
+    faults.set_schedule(None)
+    with pytest.raises(CheckpointCorrupt):
+        st.load()
+
+
+def test_kill_mid_write_preserves_committed_generations(tmp_path):
+    st = ckpt.CheckpointStore(tmp_path)
+    st.save(b"alpha")
+    st.save(b"beta")
+    faults.set_schedule("ckpt.write@1=crash:0.4")
+    with pytest.raises(SimulatedCrash):
+        st.save(b"never-lands")
+    faults.set_schedule(None)
+    # the partial temp file is not a generation and never loads
+    assert st.generations() == [1, 2]
+    assert st.load() == (2, b"beta")
+    leftovers = [n for n in os.listdir(st.root) if n.startswith(".tmp-")]
+    assert leftovers, "the crash must leave the torn temp file behind"
+    # the next save overwrites/renames past the debris
+    assert st.save(b"gamma") == 3
+    assert st.load() == (3, b"gamma")
+
+
+def test_read_side_fault_hook(tmp_path):
+    st = ckpt.CheckpointStore(tmp_path)
+    st.save(b"pristine")
+    st.save(b"latest")
+    faults.set_schedule("ckpt.read@1=bitflip:35")
+    gen, payload = st.load()              # newest read corrupt -> fallback
+    faults.set_schedule(None)
+    assert (gen, payload) == (1, b"pristine")
+
+
+# ---------------------------------------------------------------------------
+# from_checkpoint: typed corruption errors (the ISSUE satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mutate", [
+    lambda d: d[:40],                     # under the fixed-part floor
+    lambda d: d[:len(d) // 2],            # mid-payload truncation
+    lambda d: d[:-7],                     # tail truncation
+    lambda d: b"\xff" * 600,              # garbage of plausible size
+    lambda d: d[:100] + d[120:],          # 20 bytes torn out of the middle
+], ids=["floor", "half", "tail", "garbage", "torn"])
+def test_from_checkpoint_typed_corruption(spec, state_bytes, mutate):
+    with pytest.raises(CheckpointCorrupt):
+        ResidentCore.from_checkpoint(spec, mutate(state_bytes))
+
+
+def test_from_checkpoint_rejects_non_bytes(spec):
+    with pytest.raises(CheckpointCorrupt):
+        ResidentCore.from_checkpoint(spec, None)
+
+
+def test_from_checkpoint_bitflip_in_offset_table(spec, state_bytes):
+    """Flip bytes in the variable-field offset table until one produces
+    inconsistent framing: the error must be the TYPED class, whatever
+    depth the walkers notice at."""
+    saw_typed = False
+    for pos in range(0, 200, 4):
+        bad = bytearray(state_bytes)
+        bad[pos] ^= 0x80
+        try:
+            core = ResidentCore.from_checkpoint(spec, bytes(bad))
+            core._uninstall()              # parsed fine: flip was benign
+        except CheckpointCorrupt:
+            saw_typed = True
+        # any OTHER exception type fails the test by propagating
+    assert saw_typed, "no offset flip tripped validation (test is vacuous)"
+
+
+# ---------------------------------------------------------------------------
+# Store -> ResidentCore restore (mesh-shape change, device loss)
+# ---------------------------------------------------------------------------
+
+def _roots(core):
+    try:
+        return core.checkpoint_bytes(), core._state_root(core.state)
+    finally:
+        core._uninstall()
+
+
+def test_restore_across_mesh_shapes(tmp_path, spec, state_bytes):
+    """A checkpoint written under the 8-device serving mesh restores
+    under 2 devices AND single-device, bit-identically — the payload is
+    logical bytes, placement is reconstructed (ROADMAP item 4)."""
+    import jax
+    from consensus_specs_tpu.parallel.sharding import ServingMesh
+    if len(jax.devices()) < 8:
+        pytest.skip(f"needs 8 devices, have {len(jax.devices())}")
+    st = ckpt.CheckpointStore(tmp_path)
+    core8 = ResidentCore.from_checkpoint(
+        spec, state_bytes, mesh=ServingMesh.create(8))
+    st.save(core8.checkpoint_bytes())
+    ref_bytes, ref_root = _roots(core8)
+    assert ref_bytes == state_bytes                   # no transition ran
+    for mesh in (ServingMesh.create(2), None):
+        gen, core = st.restore(spec, mesh=mesh)
+        assert gen == 1
+        got_bytes, got_root = _roots(core)
+        assert got_bytes == ref_bytes and got_root == ref_root
+
+
+def test_restore_drive_after_corrupt_newest(tmp_path, spec, state_bytes):
+    """The production failover story end to end: good gen, corrupt gen,
+    restart -> fallback to the good generation, REPLAY the lost slots,
+    land on the reference state bit-for-bit."""
+    import jax
+    from consensus_specs_tpu.parallel.sharding import ServingMesh
+    if len(jax.devices()) < 8:
+        pytest.skip(f"needs 8 devices, have {len(jax.devices())}")
+    spe = int(spec.SLOTS_PER_EPOCH)
+    ref = ResidentCore.from_checkpoint(
+        spec, state_bytes, mesh=ServingMesh.create(8))
+    start = int(ref.state.slot)
+    mid = (start // spe + 1) * spe + 1
+    end = mid + spe
+    ref.process_slots(ref.state, mid)
+    mid_bytes = ref.checkpoint_bytes()
+    ref.process_slots(ref.state, end)
+    ref_bytes, ref_root = _roots(ref)
+
+    st = ckpt.CheckpointStore(tmp_path)
+    st.save(mid_bytes)                                 # good
+    faults.set_schedule("ckpt.write@1=truncate:21")
+    st.save(b"whatever-came-later")                    # corrupt on disk
+    faults.set_schedule(None)
+    gen, core = st.restore(spec, mesh=ServingMesh.create(8))
+    assert gen == 1
+    core.process_slots(core.state, end)                # replay
+    got_bytes, got_root = _roots(core)
+    assert got_bytes == ref_bytes and got_root == ref_root
+
+
+def test_mesh_device_loss_rounds_down(spec):
+    """`mesh=lose:k` drops devices at construction; ServingMesh.available
+    re-plans to the largest surviving power of two — the
+    restore-after-hardware-loss entry."""
+    import jax
+    from consensus_specs_tpu.parallel.sharding import ServingMesh
+    if len(jax.devices()) < 8:
+        pytest.skip(f"needs 8 devices, have {len(jax.devices())}")
+    faults.set_schedule("mesh@1=lose:1")
+    mesh = ServingMesh.available()
+    faults.set_schedule(None)
+    assert mesh is not None and mesh.size == 4        # 7 survivors -> 4
+    assert telemetry.counter("resilience.faults.lose",
+                             always=True).value == 1
+    assert ServingMesh.available().size == 8          # loss was one-shot
+
+
+def test_healthz_reports_rung_and_checkpoints(tmp_path, spec, state_bytes):
+    """/healthz through the API layer: rung + counters + last good
+    generation, served while syncing AND degraded."""
+    from consensus_specs_tpu.api.beacon_node import (BeaconNodeAPI,
+                                                     SyncingStatus)
+    from consensus_specs_tpu.utils.ssz.impl import deserialize
+    state = deserialize(state_bytes, spec.BeaconState)
+    api = BeaconNodeAPI(spec, state,
+                        syncing=SyncingStatus(is_syncing=True))
+    st = ckpt.CheckpointStore(tmp_path)
+    st.save(b"x" * 64)
+    resilience.ladder().degrade("test")
+    try:
+        snap = api.get_healthz()                      # no 503 while syncing
+    finally:
+        resilience.ladder().reset()
+    assert snap["status"] == "degraded"
+    assert snap["rung"]["name"] == "merkle_xla"
+    assert snap["checkpoint"]["last_good_generation"] == 1
+    assert snap["checkpoint"]["saves"] == 1
