@@ -8,7 +8,7 @@ PYTHON ?= python
 VECTOR_DIR ?= out/vectors
 JUNIT ?= out/test-results.xml
 
-.PHONY: test testall citest citest-cov citest-mainnet lint analyze contracts ranges vectors vectors-minimal bench bench-cpu multichip telemetry chaos smoke clean
+.PHONY: test testall citest citest-cov citest-mainnet lint analyze contracts ranges vectors vectors-minimal bench bench-cpu multichip telemetry chaos firehose smoke clean
 
 # measured 90.64% on the round-5 full suite; floor set just under so real
 # regressions fail while normal drift doesn't
@@ -134,6 +134,17 @@ telemetry:
 chaos:
 	$(PYTHON) tools/chaos_drill.py
 
+# Firehose smoke (tools/firehose_smoke.py): the streaming verifier under
+# sustained synthetic gossip load — waves of valid + deterministic-FALSE
+# aggregates accumulated across slot ticks into full device batches,
+# flushed at an armed deadline. Exits non-zero on any streamed-vs-
+# synchronous verdict mismatch, watchdog event, or deadline miss.
+# Artifact: out/firehose.json (CI uploads it). Bench runs the committed
+# 128-group occupancy; the smoke shape defaults to 8 for speed
+# (CSTPU_FIREHOSE_GROUPS overrides).
+firehose:
+	$(PYTHON) tools/firehose_smoke.py
+
 # Quick health check: lint + static analysis (all three tiers) + the
 # fast test modules. `make contracts` and `make ranges` ride here so an
 # op-budget or value-range regression fails at smoke time, before any
@@ -146,7 +157,8 @@ smoke:
 		--reference-root $(REFERENCE_ROOT)
 	$(MAKE) contracts
 	$(MAKE) ranges
-	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_trace_contracts.py tests/test_range_contracts.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py tests/test_telemetry.py tests/test_resilience.py tests/test_chaos_checkpoint.py -q -m "not slow"
+	$(MAKE) firehose
+	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_trace_contracts.py tests/test_range_contracts.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py tests/test_telemetry.py tests/test_resilience.py tests/test_chaos_checkpoint.py tests/test_streaming.py -q -m "not slow"
 
 clean:
 	rm -rf out .pytest_cache $(VECTOR_DIR)

@@ -1286,6 +1286,92 @@ def bench_resilience():
     return row
 
 
+def bench_firehose():
+    """The streaming-verifier acceptance row (ISSUE 15): sustained
+    synthetic gossip load through the firehose — waves of `target`
+    aggregates per slot tick, staged/dispatched while the previous batch
+    pairs on device, ONE guarded materialization per flush. Asserts:
+    streamed verdicts bit-identical to the synchronous
+    _grouped_pairing_dispatch, batch occupancy >= target (128 nominal)
+    in steady state, 0 deadline misses at the nominal load point, and 0
+    retrace / 0 re-layout watchdog events across the run. The headline
+    is the north-star: aggregate-verifies (and pairings) per second per
+    chip under firehose load, not per-block latency."""
+    from consensus_specs_tpu import streaming, telemetry
+    from consensus_specs_tpu.ops import bls_jax as BJ
+
+    target = int(os.environ.get("CSTPU_BENCH_FIREHOSE_GROUPS", 128))
+    rounds = int(os.environ.get("CSTPU_BENCH_FIREHOSE_ROUNDS", 3))
+    # the nominal-load deadline: generous on the CPU harness (the 128-
+    # group pairing is seconds there); a real accelerator run tightens it
+    deadline_ms = float(os.environ.get("CSTPU_BENCH_FIREHOSE_DEADLINE_MS",
+                                       600_000.0))
+    g1, g2 = _stage_attestation_pairs(8)   # device work value-independent
+    n_distinct, P = g1.shape[0], g1.shape[1]
+
+    def pairs_for(k):
+        i = k % n_distinct
+        return [(g1[i, p], g2[i, p]) for p in range(P)]
+
+    v = streaming.StreamingVerifier(target_groups=target,
+                                    deadline_ms=deadline_ms)
+
+    def wave(tag):
+        for k in range(target):
+            v.submit_staged((tag, k), pairs_for(k))
+
+    # warm-up flush compiles the grouped programs at the firehose shape;
+    # its verdicts double as the differential gate vs the sync dispatch
+    wave("warm")
+    v.pump()
+    warm = v.flush()
+    assert len(warm) == target and all(warm.values())
+    sync = BJ._grouped_pairing_dispatch(
+        [(("warm", k), pairs_for(k)) for k in range(target)])
+    assert sync == warm, "streamed verdicts != synchronous dispatch"
+
+    retrace0 = telemetry.counter("watchdog.retrace_events").value
+    relayout0 = telemetry.counter("watchdog.relayout_events").value
+    miss0 = telemetry.counter("firehose.deadline_miss", always=True).value
+    n_occ0 = len(v.pipeline.occupancies)
+    t0 = time.perf_counter()
+    for w in range(rounds):
+        wave(w)      # host staging of wave w overlaps wave w-1's pairing
+        v.pump()
+    res = v.flush()
+    dt = time.perf_counter() - t0
+    groups = rounds * target
+    assert len(res) == groups and all(res.values())
+    occupancies = list(v.pipeline.occupancies)[n_occ0:]
+    misses = (telemetry.counter("firehose.deadline_miss",
+                                always=True).value - miss0)
+    retrace = telemetry.counter("watchdog.retrace_events").value - retrace0
+    relayout = (telemetry.counter("watchdog.relayout_events").value
+                - relayout0)
+    assert min(occupancies) >= target, \
+        f"steady-state occupancy {min(occupancies)} < target {target}"
+    assert misses == 0, f"{misses} deadline miss(es) at the nominal load"
+    assert retrace == 0 and relayout == 0, \
+        f"firehose steady state tripped watchdogs: {retrace}/{relayout}"
+    health = streaming.firehose_health()
+    streaming.activate(None)
+    return {
+        "target_groups": target,
+        "rounds": rounds,
+        "groups_verified": groups,
+        "batches": len(occupancies),
+        "occupancy_min": int(min(occupancies)),
+        "wall_s": round(dt, 3),
+        "aggverify_per_s": round(groups / dt, 2),
+        "pairings_per_s": round(groups * P / dt, 2),
+        "deadline_ms": deadline_ms,
+        "deadline_misses": int(misses),
+        "watchdog": {"retrace_events": int(retrace),
+                     "relayout_events": int(relayout)},
+        "health": health,
+    }
+
+
 def main():
     _probe_backend()
     # virtual 8-device mesh for the sharded_vs_single stage on CPU runs
@@ -1453,8 +1539,15 @@ def main():
     bls_res = _device("BLS batch", bench_bls_device)
     t_bls, t_py_verify = bls_res if bls_res is not None else (None, None)
     if t_bls is not None:
-        _progress(f"BLS batch {t_bls * 1e3:.1f} ms; config-3 block "
-                  f"({N_ATTESTATIONS} real attestations, end-to-end)")
+        _progress(f"BLS batch {t_bls * 1e3:.1f} ms; firehose streaming "
+                  f"verifier (sustained synthetic gossip load)")
+    fh = _device("firehose", bench_firehose)
+    if fh is not None:
+        _progress("firehose: %(aggverify_per_s).1f aggverify/s/chip "
+                  "(%(pairings_per_s).0f pairings/s) at occupancy >= "
+                  "%(occupancy_min)d over %(batches)d batches, "
+                  "%(deadline_misses)d deadline misses, watchdogs 0/0; "
+                  "config-3 block next" % fh)
     t_block = _device("config-3 block", bench_block_device)
     if t_block is not None:
         _progress(f"config-3 block {t_block * 1e3:.0f} ms; python baseline")
@@ -1520,6 +1613,12 @@ def main():
     if t_bls is not None:
         parts.append("%d-agg-verify %.1f ms = %.0f aggverify/s/chip" % (
             N_ATTESTATIONS, t_bls * 1e3, N_ATTESTATIONS / t_bls))
+    if fh is not None:
+        parts.append(
+            "firehose %.1f aggverify/s/chip sustained (occupancy >= %d, "
+            "%d deadline misses, 0 retrace / 0 re-layout)" % (
+                fh["aggverify_per_s"], fh["occupancy_min"],
+                fh["deadline_misses"]))
     if t_block is not None:
         parts.append("config-3 block e2e %.0f ms" % (t_block * 1e3))
     if t_bls is not None:
@@ -1561,12 +1660,14 @@ def main():
         record["telemetry_overhead"] = trow
     if rrow is not None:
         record["resilience_overhead"] = rrow
+    if fh is not None:
+        record["firehose"] = fh
     # provenance stamp on EVERY row (not just a top-level note): a
     # cpu_fallback artifact must be distinguishable from a real capture
     # without reading logs
     tag = _probe_tag()
     record["probe"] = tag
-    for row in (inc, ab, smab, prab, svs, trow, rrow):
+    for row in (inc, ab, smab, prab, svs, trow, rrow, fh):
         if isinstance(row, dict):
             row["probe"] = tag
     # the full registry snapshot rides the artifact: per-stage span wall

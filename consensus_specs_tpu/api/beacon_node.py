@@ -262,13 +262,18 @@ class BeaconNodeAPI:
     def get_healthz(self) -> dict:
         """GET /healthz: the resilience view — current degradation-ladder
         rung, retry/deadline-miss/fault/corruption counters, and the
-        last good checkpoint generation (resilience.health_snapshot).
-        Served even while syncing AND while degraded: a node that stops
-        answering /healthz exactly when it limps is a node an operator
-        cannot triage. Counters are `always=True` metrics, so the body
-        stays truthful under CSTPU_TELEMETRY=0."""
-        from .. import resilience
-        return resilience.health_snapshot()
+        last good checkpoint generation (resilience.health_snapshot) —
+        plus the firehose section: verification-queue backlog, in-flight
+        batch count, and seconds since the last deadline flush
+        (streaming.firehose_health). Served even while syncing AND while
+        degraded: a node that stops answering /healthz exactly when it
+        limps is a node an operator cannot triage. Counters are
+        `always=True` metrics, so the body stays truthful under
+        CSTPU_TELEMETRY=0."""
+        from .. import resilience, streaming
+        snap = resilience.health_snapshot()
+        snap["firehose"] = streaming.firehose_health()
+        return snap
 
     # -----------------------------------------------------------------------
 

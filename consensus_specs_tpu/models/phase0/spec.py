@@ -65,6 +65,12 @@ class Phase0Spec:
         # instead of verifying inline (block.process_attestations_batched)
         self._att_verify_sink = None
 
+        # Streaming firehose hook (ISSUE 15): a streaming.StreamingVerifier
+        # installed here serves the sink's verdicts from its cross-slot
+        # queue/verdict cache instead of a per-block verify_indexed_batch
+        # dispatch (block.process_attestations_batched)
+        self._streaming_verifier = None
+
         # Caches (reference epilogue: build_spec.py:78-105)
         self._hash_cache: Dict[bytes, bytes] = {}
         self._perm_cache: Dict = {}

@@ -57,7 +57,9 @@ from types import SimpleNamespace
 import numpy as np
 
 from ..crypto import bls12_381 as gt
+from ..telemetry import counter as _tele_counter
 from ..telemetry import gauge as _tele_gauge
+from ..telemetry import histogram as _tele_hist
 from ..telemetry import watchdog as _watchdog
 from . import decompress as decomp
 from . import fq as F
@@ -615,27 +617,55 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def stage_group_arrays(stacks, count: int):
+    """[(g1 [count,2,L], g2 [count,2,2,L])] per group -> padded
+    (g1 [G,count,2,L], g2 [G,count,2,2,L]) batch arrays, G the next power
+    of two with copies of the last member filling the tail (log-many jit
+    shapes). The ONE batch-shape staging point shared by
+    _grouped_pairing_dispatch and the streaming firehose pipeline
+    (streaming/pipeline.py) — both must present identical program shapes
+    so the jit/persistent cache is shared. Occupancy (real vs padded
+    groups) is the launch-efficiency currency the firehose histograms."""
+    g = _next_pow2(len(stacks))
+    g1 = np.zeros((g, count, 2, F.L), np.int64)
+    g2 = np.zeros((g, count, 2, 2, F.L), np.int64)
+    for k in range(g):
+        a, b = stacks[min(k, len(stacks) - 1)]
+        g1[k] = a
+        g2[k] = b
+    return g1, g2
+
+
 def _grouped_pairing_dispatch(groups) -> dict:
     """[(key, [(g1_limbs [2,L], g2_limbs [2,2,L])...])] -> {key: verdict}.
 
     The one grouped-pairing dispatch shared by verify_multiple_batch and
     verify_indexed_batch: bucket the groups by pair count, pad each bucket
     to the next power of two with copies of its last member (log-many jit
-    shapes), run one grouped device program per bucket, scatter verdicts."""
+    shapes), run one grouped device program per bucket, scatter verdicts.
+
+    Dispatch and materialization are SEPARATE sweeps: every bucket's
+    device program launches before any verdict is fetched, so independent
+    group-count programs overlap on the device instead of serializing on
+    the first bucket's np.asarray (the per-bucket occupancy counters feed
+    the same registry names the firehose pipeline uses)."""
     verdicts: dict = {}
     by_count: dict = {}
     for key, pairs in groups:
         by_count.setdefault(len(pairs), []).append((key, pairs))
+    launched = []       # (members, device verdict array) — async, unfetched
     for count, members in by_count.items():
-        g = _next_pow2(len(members))
-        g1 = np.zeros((g, count, 2, F.L), np.int64)
-        g2 = np.zeros((g, count, 2, 2, F.L), np.int64)
-        for k in range(g):
-            _, pairs = members[min(k, len(members) - 1)]
-            g1[k] = np.stack([a for a, _ in pairs])
-            g2[k] = np.stack([b for _, b in pairs])
-        ok = np.asarray(grouped_pairing_check(jnp.asarray(g1),
-                                                   jnp.asarray(g2)))
+        stacks = [(np.stack([a for a, _ in pairs]),
+                   np.stack([b for _, b in pairs]))
+                  for _, pairs in members]
+        g1, g2 = stage_group_arrays(stacks, count)
+        _tele_counter("bls.grouped.launches").inc()
+        _tele_counter("bls.grouped.groups").inc(len(members))
+        _tele_hist("bls.grouped.occupancy").observe(len(members))
+        launched.append((members, grouped_pairing_check(jnp.asarray(g1),
+                                                        jnp.asarray(g2))))
+    for members, ok_dev in launched:
+        ok = np.asarray(ok_dev)
         for k, (key, _) in enumerate(members):
             verdicts[key] = bool(ok[k])
     return verdicts
@@ -793,6 +823,24 @@ class JaxBackend:
         Verdicts match [verify_multiple(aggregate(set_k)..., ...)] exactly:
         malformed pubkey/signature encodings fail the item, empty sets and
         infinity aggregates drop their pair, an empty product passes."""
+        results, groups = self.stage_indexed_batch(items)
+        for i, ok in _grouped_pairing_dispatch(groups).items():
+            results[i] = ok
+        return results
+
+    def stage_indexed_batch(self, items):
+        """Stages 1-3 of verify_indexed_batch (the host/device STAGING:
+        grouped pubkey aggregation, batched signature decompression,
+        batched message hashing) -> (results, groups) where results[i]
+        is the already-decided verdict (False = malformed, True = empty
+        product) or None when item i still needs its pairing check, and
+        groups = [(i, [(g1 [2,L], g2 [2,2,L])...])] is exactly the
+        pairing work _grouped_pairing_dispatch consumes. Split out so
+        the streaming firehose (streaming/verifier.py) can run the SAME
+        staging per ingested aggregate while decoupling the pairing
+        dispatch into its cross-slot batching queue — verdict
+        bit-identity with this synchronous path is the streaming
+        subsystem's acceptance contract."""
         n = len(items)
         results = [None] * n   # None = still alive
 
@@ -878,7 +926,7 @@ class JaxBackend:
         else:
             hashed = {key: gt.hash_to_g2(*key) for key in wanted}
 
-        # -- stage 4: grouped pairing check --------------------------------
+        # -- stage 4 staging: the pairing inputs ---------------------------
         neg_g1 = g1_to_limbs(gt.ec_neg(gt.G1_GEN))
         groups = []    # (item, [(g1 [2,L], g2 [2,2,L])])
         for i in range(n):
@@ -896,9 +944,7 @@ class JaxBackend:
                 results[i] = True   # empty product
             else:
                 groups.append((i, pairs))
-        for i, ok in _grouped_pairing_dispatch(groups).items():
-            results[i] = ok
-        return results
+        return results, groups
 
     @staticmethod
     def _stage_pairs(pubkeys: Sequence[bytes], message_hashes: Sequence[bytes],

@@ -58,8 +58,12 @@ _HEALTH_COUNTERS = (
     # degradations.single_device > 0 knows a core may still be serving
     # unsharded until the next restore
     "resilience.degradations.single_device",
+    # salvaged = deadline-missed-but-landed outputs: the firehose flush
+    # (streaming/pipeline.py) and zero-retry donated sites both surface
+    # lateness here rather than as unavailability
+    "resilience.deadline_salvaged",
     "resilience.faults_injected", "watchdog.retrace_events",
-    "watchdog.relayout_events",
+    "watchdog.relayout_events", "firehose.deadline_miss",
 )
 
 

@@ -1,0 +1,93 @@
+"""Streaming verification: the attestation-firehose subsystem (ISSUE 15).
+
+Decouples BLS signature verification from `state_transition`. Mainnet
+traffic is a gossip firehose — thousands of aggregates per slot from
+~1M attesting validators — and the grouped-Miller kernel amortizes its
+fq12 squarings across GROUPS (PAPERS.md [2]): it only pays off when fed
+full device batches, which one block's worth of attestations never is.
+This package accumulates verification work ACROSS slots into full
+batches and overlaps the host staging of batch N+1 with the device
+pairing of batch N:
+
+  * queue.py    — `VerificationQueue`: staged pairing groups bucketed by
+                  pair count, accumulated across slots toward a target
+                  batch occupancy (>= 128 groups per launch).
+  * pipeline.py — `FirehosePipeline`: async dispatch of full batches
+                  through `resilience.guarded_dispatch`, per-batch
+                  verdicts scattered into a device-resident ring buffer
+                  (donated in-place on accelerators), ONE host transfer
+                  at the fork-choice deadline — `jax.block_until_ready`
+                  only there; a deadline miss flushes the partial batch
+                  late (salvaged) instead of stalling.
+  * verifier.py — `StreamingVerifier`: the facade. Ingests aggregates
+                  (SSZ gossip payloads via the networking decode path,
+                  or pre-staged items), dedups by content digest, stages
+                  through the SAME host pipeline as the synchronous path
+                  (`JaxBackend.stage_indexed_batch`), and hands
+                  per-attestation verdicts back to `state_transition` /
+                  fork-choice — bit-identical to the synchronous path.
+
+Telemetry control surface (the PR 7 registry; all counters always=True
+so /healthz stays truthful under CSTPU_TELEMETRY=0): spans
+`firehose.{stage,dispatch,flush,harvest}` with exit-only fences, gauge
+`firehose.queue_depth`, pow2 histogram `firehose.batch_occupancy`,
+counters `firehose.deadline_miss` (+ ingested/duplicates/cache_hits/
+launches/groups_verified). `BeaconNodeAPI.get_healthz()` serves
+`firehose_health()`; `/metrics` exposes the instruments.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .pipeline import FirehosePipeline
+from .queue import VerificationQueue
+from .verifier import StreamingVerifier
+
+__all__ = [
+    "FirehosePipeline", "StreamingVerifier", "VerificationQueue",
+    "activate", "active", "firehose_health",
+]
+
+# the process-global verifier /healthz reports (the DegradationLadder
+# idiom: last activated wins; None = no firehose running)
+_ACTIVE: Optional[StreamingVerifier] = None
+
+
+def activate(verifier: Optional[StreamingVerifier]):
+    """Install `verifier` as the process-global firehose (what /healthz
+    and `firehose_health` report). Returns the previous one so tests and
+    drills can restore it."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = verifier
+    return prev
+
+
+def active() -> Optional[StreamingVerifier]:
+    return _ACTIVE
+
+
+def firehose_health() -> dict:
+    """The /healthz firehose section: queue backlog, in-flight batches,
+    seconds since the last flush, and the always-on counters — a plain
+    JSON-ready dict, meaningful (all-zero backlog, None flush age) even
+    when no verifier is active."""
+    from .. import telemetry
+
+    v = _ACTIVE
+    last_flush = v.pipeline.last_flush_at if v is not None else None
+    return {
+        "backlog": v.queue.depth if v is not None else 0,
+        "in_flight_batches": v.pipeline.in_flight if v is not None else 0,
+        "last_flush_age_s": (round(time.monotonic() - last_flush, 3)
+                             if last_flush is not None else None),
+        "target_groups": v.queue.target_groups if v is not None else None,
+        "counters": {
+            name: int(telemetry.counter(f"firehose.{name}",
+                                        always=True).value)
+            for name in ("ingested", "duplicates", "cache_hits",
+                         "launches", "groups_verified", "deadline_miss",
+                         "partial_flushes")
+        },
+    }

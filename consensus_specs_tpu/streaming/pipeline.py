@@ -1,0 +1,294 @@
+"""Double-buffered device pipeline for the attestation firehose
+(ISSUE 15 tentpole).
+
+`FirehosePipeline` owns the device side of the streaming verifier:
+
+  * **async dispatch** — each full batch launches the SAME two grouped
+    pairing programs the synchronous path uses
+    (`ops/bls_jax.grouped_pairing_check`, so the jit + persistent
+    compile caches are shared), through `resilience.guarded_dispatch`
+    UNARMED: no deadline, no fence — the launch returns immediately and
+    the host goes back to staging the next batch (decompression +
+    hash-to-curve of batch N+1 overlaps the pairing of batch N).
+  * **verdict ring** — every batch's [G] verdict vector is scattered
+    into a device-resident ring buffer by a one-equation
+    `dynamic_update_slice` program whose ring argument is DONATED on
+    accelerator backends (in-place update, byte-exact aliasing;
+    XLA:CPU runs the undonated twin — persistent-cache-deserialized
+    donated CPU executables have violated input/output aliasing, the
+    PR 3 caveat). Verdicts therefore accumulate ON DEVICE; nothing is
+    transferred per batch.
+  * **deadline-bounded flush** — `flush(deadline_ms)` is the ONLY point
+    that blocks: one guarded, wall-clock-budgeted materialization of the
+    ring (`jax.block_until_ready` semantics at the fork-choice deadline,
+    ROADMAP item 1). The guard runs with retries=0, so a late result is
+    SALVAGED — the partial batch still lands, the miss is counted
+    (`firehose.deadline_miss`, `resilience.deadline_misses`) and stays
+    visible on /healthz — instead of a retry loop stalling fork choice.
+  * **watchdogs** — the retrace watchdog wraps the ring-scatter program
+    (shape-pinned key) and the re-layout watchdog fingerprints the
+    chained ring buffer each scatter: a steady-state firehose must
+    launch with ZERO events of either kind (the bench/smoke acceptance).
+
+Degradation wiring: the pairing programs read the committed oracle
+knobs at dispatch time (`_redc_mode_jit` keys one program per
+CSTPU_FQ_REDC backend), so the PR 13 ladder's `redc_leaf` /
+`scalar_double_add` rungs degrade the firehose the same bit-identical
+way they degrade the block path — no extra plumbing here.
+"""
+from __future__ import annotations
+
+import collections
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..telemetry import watchdog as _watchdog
+from ._metrics import counter as _counter
+from ._metrics import histogram as _histogram
+from ._metrics import span as _span
+
+
+# ---------------------------------------------------------------------------
+# Verdict-ring scatter program
+# ---------------------------------------------------------------------------
+
+def _ring_scatter(ring, verdicts, start):
+    """ring [R] bool, verdicts [G] bool, start scalar -> updated ring.
+    The ring argument is donated on accelerators (same shape/dtype in and
+    out: the aliasing survives lowering — pinned by the trace contract
+    below), so steady-state batches update one resident buffer with no
+    allocation and no transfer."""
+    import jax
+    return jax.lax.dynamic_update_slice(ring, verdicts, (start,))
+
+
+_RING_JITS: dict = {}
+
+
+def _ring_scatter_jit():
+    """One jitted scatter per donation mode, resolved from the live
+    platform (the epoch-donation idiom: donate on accelerators, pinned
+    undonated on XLA:CPU)."""
+    import jax
+    donate = jax.devices()[0].platform != "cpu"
+    prog = _RING_JITS.get(donate)
+    if prog is None:
+        kwargs = {"donate_argnums": (0,)} if donate else {}
+        _RING_JITS[donate] = prog = jax.jit(_ring_scatter, **kwargs)
+    return prog
+
+
+class FirehosePipeline:
+    """Async grouped-pairing dispatch + device verdict ring + deadline
+    flush. `clock`/`sleep` are forwarded to `guarded_dispatch`, so the
+    deadline tests run on a fake clock with zero real sleeps."""
+
+    def __init__(self, *, deadline_ms: Optional[float] = None,
+                 ring_capacity: int = 1024,
+                 clock: Callable[[], float] = time.perf_counter,
+                 sleep: Callable[[float], None] = time.sleep):
+        assert ring_capacity >= 1
+        self.deadline_ms = deadline_ms
+        self.ring_capacity = int(ring_capacity)
+        self._clock = clock
+        self._sleep = sleep
+        self._ring = None               # device [R] bool, lazily allocated
+        self._offset = 0                # next free ring slot
+        self._pending: List[tuple] = []  # (keys, start, n) awaiting harvest
+        self._harvested: Dict[object, bool] = {}   # ring drained early
+        self.last_flush_at: Optional[float] = None
+        self.launches = 0
+        # real groups of the most recent launches (bounded: a sustained
+        # firehose must not grow host state per launch — cumulative
+        # totals live in the always-on counters)
+        self.occupancies: collections.deque = collections.deque(
+            maxlen=4096)
+
+    # -- state ----------------------------------------------------------
+
+    @property
+    def in_flight(self) -> int:
+        """Batches dispatched and not yet flushed."""
+        return len(self._pending)
+
+    # -- dispatch (async) ------------------------------------------------
+
+    def dispatch(self, count: int, members) -> None:
+        """Launch one batch: members = [(key, g1 [count,2,L],
+        g2 [count,2,2,L])]. Returns immediately — the pairing programs
+        and the ring scatter are all async; nothing is fetched until
+        `flush`."""
+        import jax.numpy as jnp
+        from ..ops import bls_jax as BJ
+        from ..resilience import guarded_dispatch
+
+        keys = [m[0] for m in members]
+        g1, g2 = BJ.stage_group_arrays([(m[1], m[2]) for m in members],
+                                       count)
+        g = g1.shape[0]
+        if g > self.ring_capacity:
+            # a clear configuration error, not a trace-time XLA shape
+            # failure from dynamic_update_slice(update > operand)
+            raise ValueError(
+                f"firehose batch pads to {g} groups but the verdict "
+                f"ring holds {self.ring_capacity}; size ring_capacity "
+                f">= the padded target occupancy")
+        if self._offset + g > self.ring_capacity:
+            # ring full before the deadline: drain early (counted — at
+            # the nominal load point the capacity covers a whole window)
+            _counter("firehose.ring_wraps").inc()
+            self._harvested.update(self._drain())
+        with _span("firehose.dispatch", groups=len(members), pairs=count,
+                   padded=g):
+            # unarmed guard: async launch in a try-frame — taxonomy and
+            # transient retry apply (host-staged inputs are re-usable),
+            # the deadline only ever arms the flush
+            out = guarded_dispatch(
+                ("firehose.batch", count, g), BJ.grouped_pairing_check,
+                jnp.asarray(g1), jnp.asarray(g2),
+                deadline_ms=0.0, clock=self._clock, sleep=self._sleep)
+            ring = self._ring
+            if ring is None:
+                ring = jnp.zeros((self.ring_capacity,), bool)
+            self._ring = _watchdog.dispatch(
+                ("firehose.ring", self.ring_capacity, g),
+                _ring_scatter_jit(), ring, out, np.int32(self._offset))
+        # the chained ring value: any placement change between scatters
+        # is a re-layout event (ONE key covers every step)
+        _watchdog.layout_check(("firehose.ring.layout",
+                                self.ring_capacity), self._ring)
+        self._pending.append((keys, self._offset, len(members)))
+        self._offset += g
+        self.launches += 1
+        self.occupancies.append(len(members))
+        _counter("firehose.launches").inc()
+        _counter("firehose.groups_launched").inc(len(members))
+        _histogram("firehose.batch_occupancy").observe(len(members))
+
+    # -- flush (the only blocking point) ---------------------------------
+
+    def _drain(self) -> Dict[object, bool]:
+        """Materialize the ring and map every pending batch's verdicts.
+        The ONE device->host transfer; callers decide whether it runs
+        under a deadline guard."""
+        verdicts: Dict[object, bool] = {}
+        if not self._pending:
+            return verdicts
+        ok = np.asarray(self._ring)
+        for keys, start, n in self._pending:
+            for k, key in enumerate(keys):
+                verdicts[key] = bool(ok[start + k])
+        self._pending = []
+        self._offset = 0
+        return verdicts
+
+    def flush(self, deadline_ms: Optional[float] = None
+              ) -> Dict[object, bool]:
+        """Block on everything in flight and return {key: verdict}.
+
+        With a wall-clock budget armed (`deadline_ms` or the pipeline
+        default), the materialization runs through `guarded_dispatch`
+        with retries=0: a late ring is SALVAGED (the verdicts still
+        land — discarding correct work would only convert lateness into
+        unavailability) and the miss is counted on /healthz."""
+        from .. import telemetry
+        from ..resilience import guarded_dispatch
+
+        if deadline_ms is None:
+            deadline_ms = self.deadline_ms
+        verdicts = dict(self._harvested)
+        self._harvested = {}
+        with _span("firehose.flush", batches=len(self._pending),
+                   deadline_ms=deadline_ms or 0):
+            if self._pending:
+                misses0 = telemetry.counter(
+                    "resilience.deadline_misses", always=True).value
+                verdicts.update(guarded_dispatch(
+                    ("firehose.flush", self.ring_capacity), self._drain,
+                    deadline_ms=deadline_ms or 0.0, retries=0,
+                    clock=self._clock, sleep=self._sleep))
+                missed = telemetry.counter(
+                    "resilience.deadline_misses", always=True).value - misses0
+                if missed:
+                    _counter("firehose.deadline_miss").inc(missed)
+        _counter("firehose.groups_verified").inc(len(verdicts))
+        self.last_flush_at = time.monotonic()
+        return verdicts
+
+
+# ---------------------------------------------------------------------------
+# Trace-tier kernel contracts (tools/analysis/trace/, `make contracts`)
+# ---------------------------------------------------------------------------
+# The steady-state firehose verification program at the COMMITTED batch
+# shape — G = 128 groups x P = 3 pairs, the >= 128-group occupancy the
+# bench/smoke acceptance asserts — plus the verdict-ring scatter. The
+# grouped-Miller / batched-verdict REDC-lane pins are EXACTLY 128x the
+# per-group budgets the ops.bls_jax contracts pin at G = 1 (396/672
+# Miller, 967 verdict): the lane cost is linear in the batch axis, so
+# any super-linear drift — a per-group recombination escaping the
+# shared-squaring structure at the wide shape — breaks the pin. Zero
+# device_put end to end, and the ring's in-place donation must survive
+# lowering.
+
+_FIREHOSE_G = 128     # committed steady-state batch occupancy
+_FIREHOSE_P = 3       # spec aggregate-verify pair count
+
+
+def _firehose_miller_build(mode):
+    import jax.numpy as jnp
+    from ..ops import bls_jax as BJ
+    from ..ops import fq as F
+    return dict(
+        fn=BJ.miller_loop_grouped,
+        args=(jnp.zeros((_FIREHOSE_G, _FIREHOSE_P, 2, F.L), jnp.int64),
+              jnp.zeros((_FIREHOSE_G, _FIREHOSE_P, 2, 2, F.L), jnp.int64)),
+        context=lambda: F.pinned_fq_redc_backend(mode))
+
+
+def _firehose_verdict_build():
+    import jax.numpy as jnp
+    from ..ops import bls_jax as BJ
+    from ..ops import fq as F
+    return dict(
+        fn=BJ._grouped_verdict,
+        args=(jnp.zeros((_FIREHOSE_G, 2, 3, 2, F.L), jnp.int64),),
+        context=lambda: F.pinned_fq_redc_backend("coeff"))
+
+
+def _ring_scatter_build():
+    import jax.numpy as jnp
+    return dict(
+        fn=_ring_scatter,
+        args=(jnp.zeros((1024,), bool),
+              jnp.zeros((_FIREHOSE_G,), bool), np.int32(0)),
+        jit_kwargs={"donate_argnums": (0,)})
+
+
+TRACE_CONTRACTS = [
+    dict(
+        name=f"streaming.pipeline.firehose_miller[{mode}]",
+        build=(lambda m=mode: _firehose_miller_build(m)),
+        budgets={"redc_lanes": lanes},
+        exact=("redc_lanes",),
+        forbid=("f64", "callback", "device_put"),
+    )
+    for mode, lanes in (("coeff", 396 * _FIREHOSE_G),
+                        ("leaf", 672 * _FIREHOSE_G))
+] + [
+    dict(
+        name="streaming.pipeline.firehose_verdict[coeff]",
+        build=_firehose_verdict_build,
+        budgets={"redc_lanes": 967 * _FIREHOSE_G},
+        exact=("redc_lanes",),
+        forbid=("f64", "callback", "device_put"),
+    ),
+    dict(
+        name="streaming.pipeline.verdict_ring_scatter",
+        build=_ring_scatter_build,
+        budgets={"jaxpr_eqns": 4},
+        donate_min=1,
+        forbid=("f64", "callback", "device_put"),
+    ),
+]

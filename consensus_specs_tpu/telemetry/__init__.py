@@ -19,10 +19,14 @@ CSTPU_TELEMETRY_RING (span ring-buffer size, default 4096).
 
 Naming scheme (dot-separated `subsystem.stage`): spans `epoch.*`
 (process_epoch_soa stages), `resident.*` (the resident serving loop),
-`bench.*` / `followup.*` (harnesses); counters `fq.redc.*` (trace-time
-REDC accounting), `merkle.forest.*` (pair-hash lanes/launches/builds),
-`scalar_mul.*`, `watchdog.*` (retrace/re-layout events),
-`jax.backend_compiles` (global compile listener).
+`firehose.*` (streaming-verifier pipeline stages: stage/dispatch/flush,
+exit-only fences), `bench.*` / `followup.*` (harnesses); counters
+`fq.redc.*` (trace-time REDC accounting), `merkle.forest.*` (pair-hash
+lanes/launches/builds), `scalar_mul.*`, `bls.grouped.*` (grouped-pairing
+launch occupancy), `firehose.*` (queue depth / batch occupancy /
+deadline misses — always-on: /healthz reads them), `watchdog.*`
+(retrace/re-layout events), `jax.backend_compiles` (global compile
+listener).
 """
 from .core import (Counter, Gauge, Histogram, Span, counter, current_span,
                    enabled, fencing, gauge, histogram, instrument, reset,

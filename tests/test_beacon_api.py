@@ -152,6 +152,43 @@ def test_healthz_reflects_degradation(api):
     assert api.get_healthz()["rung"]["index"] == 0
 
 
+def test_healthz_firehose_section(api):
+    """/healthz carries the firehose view: queue backlog, in-flight
+    batches, last-flush age (ISSUE 15 satellite) — zeroed when no
+    streaming verifier is active, live when one is."""
+    from consensus_specs_tpu import streaming
+    snap = api.get_healthz()
+    assert snap["firehose"]["backlog"] == 0
+    assert snap["firehose"]["last_flush_age_s"] is None
+    v = streaming.StreamingVerifier(target_groups=8, register=True)
+    try:
+        live = api.get_healthz()["firehose"]
+        assert live["target_groups"] == 8
+        assert live["in_flight_batches"] == 0
+        assert set(live["counters"]) >= {"ingested", "duplicates",
+                                         "cache_hits", "deadline_miss",
+                                         "partial_flushes"}
+    finally:
+        streaming.activate(None)
+    assert v.queue.depth == 0
+
+
+def test_metrics_expose_firehose_instruments(api):
+    """The firehose gauges/counters ride /metrics (queue depth gauge,
+    batch-occupancy histogram name space, deadline-miss counter)."""
+    from consensus_specs_tpu import streaming
+    v = streaming.StreamingVerifier(target_groups=8, register=True)
+    try:
+        api.get_healthz()            # touches the always-on counters
+        text = api.get_metrics()
+        assert "cstpu_firehose_queue_depth" in text
+        assert "cstpu_firehose_deadline_miss_total" in text
+        assert "cstpu_firehose_ingested_total" in text
+    finally:
+        streaming.activate(None)
+    assert v.pipeline.in_flight == 0
+
+
 def test_duty_proposal_slot_covers_future_slots(api):
     """Every slot in the rest of the epoch must be claimable by exactly one
     duty: scanning all validators' duties, the proposal slots seen must
