@@ -8,7 +8,7 @@ PYTHON ?= python
 VECTOR_DIR ?= out/vectors
 JUNIT ?= out/test-results.xml
 
-.PHONY: test testall citest citest-cov citest-mainnet lint analyze contracts ranges vectors vectors-minimal bench bench-cpu multichip telemetry chaos firehose smoke clean
+.PHONY: test testall citest citest-cov citest-mainnet lint analyze contracts ranges lifetime vectors vectors-minimal bench bench-cpu multichip telemetry chaos firehose smoke clean
 
 # measured 90.64% on the round-5 full suite; floor set just under so real
 # regressions fail while normal drift doesn't
@@ -95,6 +95,22 @@ ranges:
 		--ranges-baseline tools/analysis/ranges_baseline.json \
 		--json out/ranges.json
 
+# Buffer-lifetime tier (tools/analysis/lifetime/): the interprocedural
+# donation/aliasing prover (CSA1501-1505) — abstract LIVE / DONATED /
+# MAYBE-DONATED ownership states flow over the call-graph IR through
+# calls, dispatch wrappers, attribute stores, destructuring and loops,
+# cross-checked against the `tf.aliasing_output` annotations that
+# survive the REAL lowerings of the donate_min trace contracts. Exit
+# 0 = the committed tree proves clean (every donated buffer rebound,
+# returned, or routed through utils/donation.platform_donated_jit).
+# JSON artifact: out/lifetime.json. Accepted findings ratchet via
+# tools/analysis/lifetime_baseline.json (--update-lifetime-baseline).
+lifetime:
+	mkdir -p out
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.analysis --lifetime \
+		--lifetime-baseline tools/analysis/lifetime_baseline.json \
+		--json out/lifetime.json
+
 # Conformance vectors, both presets (reference: make gen_yaml_tests).
 vectors:
 	$(PYTHON) -m consensus_specs_tpu.generators -o $(VECTOR_DIR)
@@ -145,10 +161,10 @@ chaos:
 firehose:
 	$(PYTHON) tools/firehose_smoke.py
 
-# Quick health check: lint + static analysis (all three tiers) + the
-# fast test modules. `make contracts` and `make ranges` ride here so an
-# op-budget or value-range regression fails at smoke time, before any
-# bench run.
+# Quick health check: lint + static analysis (all four tiers) + the
+# fast test modules. `make contracts`, `make ranges` and `make
+# lifetime` ride here so an op-budget, value-range or buffer-lifetime
+# regression fails at smoke time, before any bench run.
 smoke:
 	$(PYTHON) tools/lint.py consensus_specs_tpu tests bench.py __graft_entry__.py tools
 	$(PYTHON) -m tools.analysis --list-rules >/dev/null
@@ -157,8 +173,9 @@ smoke:
 		--reference-root $(REFERENCE_ROOT)
 	$(MAKE) contracts
 	$(MAKE) ranges
+	$(MAKE) lifetime
 	$(MAKE) firehose
-	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_trace_contracts.py tests/test_range_contracts.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py tests/test_telemetry.py tests/test_resilience.py tests/test_chaos_checkpoint.py tests/test_streaming.py -q -m "not slow"
+	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_trace_contracts.py tests/test_range_contracts.py tests/test_lifetime.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py tests/test_telemetry.py tests/test_resilience.py tests/test_chaos_checkpoint.py tests/test_streaming.py -q -m "not slow"
 
 clean:
 	rm -rf out .pytest_cache $(VECTOR_DIR)

@@ -1691,6 +1691,13 @@ def main():
     # a capture also records the value budgets its kernels were proven
     # under. Pure declaration reads again: `make ranges` does the proving.
     record["ranges"] = _ranges_snapshot()
+    # ... and the buffer-lifetime snapshot (the donation/aliasing
+    # prover's finding count over the committed tree + a hash of the
+    # accepted-findings baseline), so a capture records that the code
+    # it measured proved clean of use-after-donate hazards. The prover
+    # is pure AST interpretation (no lowering here: `make lifetime`
+    # does the cross-check).
+    record["lifetime"] = _lifetime_snapshot()
     print(json.dumps(record))
 
 
@@ -1711,6 +1718,24 @@ def _ranges_snapshot():
         return {"declared": _ranges_engine.declared_snapshot(contracts),
                 "baseline": _ranges_engine.load_ranges_baseline()}
     except Exception as exc:   # a broken registry must not sink a capture
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _lifetime_snapshot():
+    try:
+        import hashlib
+        from tools.analysis.lifetime import engine as _lt_engine
+        report = _lt_engine.run_lifetime(lower=False)
+        base = _lt_engine.DEFAULT_BASELINE
+        digest = hashlib.sha256(base.read_bytes()).hexdigest() \
+            if base.exists() else None
+        return {"findings": len(report.findings),
+                "suppressed": len(report.suppressed),
+                "baselined": len(report.baselined),
+                "donors": report.donors,
+                "files_checked": report.files_checked,
+                "baseline_sha256": digest}
+    except Exception as exc:   # a broken prover must not sink a capture
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 

@@ -35,6 +35,7 @@ import numpy as np
 
 from ... import telemetry
 from ...ops import intmath  # enables jax_enable_x64 on import
+from ...utils.donation import platform_donated_jit
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -379,13 +380,15 @@ def _epoch_transition_traced(cfg: EpochConfig, cols: ValidatorColumns,
 # The donated form: every output column matches an input column's
 # shape/dtype, so XLA updates the registry in place instead of holding
 # input+output copies in HBM (the 1M-validator column set is ~7x8 MB —
-# donation halves its footprint during the epoch program). The donation
-# actually sticking (no "donated buffer unused" warnings, input buffers
-# consumed) is asserted in tests/test_epoch_soa.py against this jit.
-_epoch_transition_donated = partial(
-    jax.jit, static_argnums=(0,), donate_argnums=(1,))(_epoch_transition_traced)
-_epoch_transition_undonated = partial(
-    jax.jit, static_argnums=(0,))(_epoch_transition_traced)
+# donation halves its footprint during the epoch program). The twins
+# come from the shared platform_donated_jit helper (utils/donation.py);
+# both halves stay importable — tests assert the donation sticks (no
+# "donated buffer unused" warnings, input buffers consumed) against the
+# donated twin, and bench's recovery drill re-dispatches the undonated.
+_epoch_transition_pd = platform_donated_jit(
+    _epoch_transition_traced, static_argnums=(0,), donate_argnums=(1,))
+_epoch_transition_donated = _epoch_transition_pd.donated
+_epoch_transition_undonated = _epoch_transition_pd.undonated
 
 
 def epoch_transition_device(cfg: EpochConfig, cols: ValidatorColumns,
@@ -414,8 +417,7 @@ def _epoch_transition_jit():
     """The backend-selected jitted epoch program (donated off-CPU) — the
     dispatch point the retrace watchdog wraps (resident.py passes it to
     telemetry.watchdog.dispatch with a shape-pinned key)."""
-    return (_epoch_transition_undonated if jax.default_backend() == "cpu"
-            else _epoch_transition_donated)
+    return _epoch_transition_pd.resolve()
 
 
 _stage_a_jit = partial(jax.jit, static_argnums=(0,))(_stage_a_traced)

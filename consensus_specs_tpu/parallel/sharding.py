@@ -23,6 +23,7 @@ from ..models.phase0.epoch_soa import (
     _epoch_transition_traced)
 from ..resilience import faults as _faults
 from ..resilience.dispatch import RETRIES_DEFAULT, guarded_dispatch
+from ..utils.donation import platform_donated_jit
 from ..utils.merkle import next_power_of_two
 
 
@@ -287,19 +288,20 @@ class ServingMesh:
         `check` (resilience/integrity.py) tripwires the output before it
         can chain (the caller decides how to degrade — ResidentCore
         walks the ladder)."""
-        donate = jax.default_backend() != "cpu"
-        key = ("epoch", cfg, donate)
-        fn = self._jits.get(key)
-        if fn is None:
+        key = ("epoch", cfg)
+        pd = self._jits.get(key)
+        if pd is None:
             cols_sh, scal_sh, inp_sh = self.epoch_shardings()
             report_sh = EpochReport(
                 *([self.replicated] * len(EpochReport._fields)))
-            fn = jax.jit(
+            pd = platform_donated_jit(
                 partial(_epoch_transition_traced, cfg),
                 in_shardings=(cols_sh, scal_sh, inp_sh),
                 out_shardings=(cols_sh, scal_sh, report_sh),
-                donate_argnums=(0,) if donate else ())
-            self._jits[key] = fn
+                donate_argnums=(0,))
+            self._jits[key] = pd
+        donate = pd.donate_now()
+        fn = pd.resolve()
         # retrace watchdog: the key pins the full static context (mesh
         # size, padded V, config), so any compile-cache miss after the
         # first compile is a genuine retrace of the steady-state program.

@@ -45,6 +45,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..telemetry import watchdog as _watchdog
+from ..utils.donation import platform_donated_jit
 from ._metrics import counter as _counter
 from ._metrics import histogram as _histogram
 from ._metrics import span as _span
@@ -64,20 +65,17 @@ def _ring_scatter(ring, verdicts, start):
     return jax.lax.dynamic_update_slice(ring, verdicts, (start,))
 
 
-_RING_JITS: dict = {}
+# Twin jitted scatters resolved from the live platform (donate on
+# accelerators, pinned undonated on XLA:CPU) — the shared
+# platform_donated_jit helper builds lazily, so declaring it here keeps
+# this module's no-jax-at-import property.
+_ring_scatter_pd = platform_donated_jit(_ring_scatter, donate_argnums=(0,))
 
 
 def _ring_scatter_jit():
-    """One jitted scatter per donation mode, resolved from the live
-    platform (the epoch-donation idiom: donate on accelerators, pinned
-    undonated on XLA:CPU)."""
-    import jax
-    donate = jax.devices()[0].platform != "cpu"
-    prog = _RING_JITS.get(donate)
-    if prog is None:
-        kwargs = {"donate_argnums": (0,)} if donate else {}
-        _RING_JITS[donate] = prog = jax.jit(_ring_scatter, **kwargs)
-    return prog
+    """The backend-selected jitted scatter (a plain jax.jit object, so
+    the retrace watchdog sees its compile cache)."""
+    return _ring_scatter_pd.resolve()
 
 
 class FirehosePipeline:

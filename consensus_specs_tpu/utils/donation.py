@@ -1,0 +1,110 @@
+"""The one blessed construction site for platform-conditional donation.
+
+PR 3 caveat, codified: XLA:CPU executables deserialized from the
+persistent compilation cache have intermittently violated donated
+input/output aliasing (observed on jax 0.4.37 as a donated epoch
+program clobbering the balance column with the activation-queue iota
+after the second chained boundary; fresh compiles never reproduced
+it). Every donating program in this repo therefore ships as a twin:
+donated on accelerator backends (in-place update, halved HBM
+footprint), pinned UNDONATED on XLA:CPU so correctness never depends
+on cache temperature.
+
+That idiom used to be hand-rolled four ways (streaming/pipeline.py's
+_RING_JITS dict, parallel/sharding.py's donate-keyed jit cache,
+models/phase0/epoch_soa.py's module-level twins, utils/ssz/
+incremental.py's selector). `platform_donated_jit` is the shared
+form, and the buffer-lifetime tier (tools/analysis/lifetime/,
+CSA1504) whitelists exactly it: a raw `donate_argnums` jit with no
+platform guard is a finding.
+
+The module never imports jax at top level — declaring a twin costs
+nothing until a program is actually resolved, so the lazy-import
+modules (streaming/pipeline.py) can declare one at module scope.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict
+
+
+class PlatformDonatedJit:
+    """Twin-jit dispatcher: `.donated` / `.undonated` build lazily on
+    first access; `resolve()` picks by the LIVE backend (donate unless
+    it is XLA:CPU); calling the instance resolves per call. Both twins
+    are ordinary `jax.jit` objects, so watchdog cache introspection
+    (`fn._cache_size`) and `.lower()` work on whichever `resolve()`
+    returns."""
+
+    def __init__(self, fun, *, donate_argnums=(), donate_argnames=(),
+                 **jit_kwargs):
+        assert donate_argnums or donate_argnames, \
+            "platform_donated_jit without donated args is just jax.jit"
+        try:
+            params = list(inspect.signature(fun).parameters.values())
+        except (TypeError, ValueError):
+            params = None   # builtins/partials without introspection
+        if params is not None and not any(
+                p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD)
+                for p in params):
+            names = [p.name for p in params]
+            for i in donate_argnums:
+                assert 0 <= i < len(names), \
+                    f"donate_argnums={i} out of range for " \
+                    f"{getattr(fun, '__name__', fun)}({', '.join(names)})"
+            for n in donate_argnames:
+                assert n in names, \
+                    f"donate_argnames={n!r} not a parameter of " \
+                    f"{getattr(fun, '__name__', fun)}({', '.join(names)})"
+        self._fun = fun
+        self._donate: Dict[str, Any] = {}
+        if donate_argnums:
+            self._donate["donate_argnums"] = tuple(donate_argnums)
+        if donate_argnames:
+            self._donate["donate_argnames"] = tuple(donate_argnames)
+        self._jit_kwargs = dict(jit_kwargs)
+        self._twins: Dict[bool, Any] = {}
+
+    def _twin(self, donate: bool):
+        prog = self._twins.get(donate)
+        if prog is None:
+            import jax
+            kwargs = dict(self._jit_kwargs)
+            if donate:
+                kwargs.update(self._donate)
+            self._twins[donate] = prog = jax.jit(self._fun, **kwargs)
+        return prog
+
+    @property
+    def donated(self):
+        """The donating twin (tests assert donation sticks against it;
+        recovery drills that must NOT donate use `.undonated`)."""
+        return self._twin(True)
+
+    @property
+    def undonated(self):
+        return self._twin(False)
+
+    def donate_now(self) -> bool:
+        """Whether the LIVE backend gets the donating twin — callers
+        that key caches or set retry policy on donation ask this
+        instead of re-deriving the platform check."""
+        import jax
+        return jax.default_backend() != "cpu"
+
+    def resolve(self):
+        """The backend-selected jitted program, a plain jax.jit object
+        (stable identity per twin — retrace-watchdog keys and compile
+        caches see one callable per donation mode)."""
+        return self._twin(self.donate_now())
+
+    def __call__(self, *args, **kwargs):
+        return self.resolve()(*args, **kwargs)
+
+
+def platform_donated_jit(fun, **kwargs) -> PlatformDonatedJit:
+    """jax.jit with donation on accelerator backends only — the house
+    donate-on-accel/undonated-on-CPU idiom as one helper. Accepts every
+    jax.jit kwarg; `donate_argnums`/`donate_argnames` apply only to the
+    accelerator twin."""
+    return PlatformDonatedJit(fun, **kwargs)

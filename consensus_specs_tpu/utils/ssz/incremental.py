@@ -33,7 +33,6 @@ the O(dirty * log V) work bound instead of trusting wall-clock.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import List
 
 import numpy as np
@@ -41,6 +40,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..donation import platform_donated_jit
 from ..hash import ZERO_BYTES32
 from ..merkle import next_power_of_two, tree_depth
 from ...ops.sha256 import (_unroll_for, bytes_to_words, merkle_pair_backend_name,
@@ -56,29 +56,24 @@ _PAIR_LAUNCHES = _tele_counter("merkle.forest.launches")
 _FOREST_BUILDS = _tele_counter("merkle.forest.builds")
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _scatter_rows_donated(level: jnp.ndarray, idx: jnp.ndarray,
-                          rows: jnp.ndarray) -> jnp.ndarray:
+def _scatter_rows_traced(level: jnp.ndarray, idx: jnp.ndarray,
+                         rows: jnp.ndarray) -> jnp.ndarray:
     return level.at[idx].set(rows)
 
 
-@jax.jit
-def _scatter_rows_undonated(level: jnp.ndarray, idx: jnp.ndarray,
-                            rows: jnp.ndarray) -> jnp.ndarray:
-    return level.at[idx].set(rows)
+# level.at[idx].set(rows) with the old buffer donated on accelerator
+# backends: the update rewrites the resident level in place instead of
+# copying O(n) rows. XLA:CPU keeps the undonated (copying) form — CPU
+# executables deserialized from the persistent compilation cache have
+# been observed to violate donated input/output aliasing (see
+# utils/donation.py), and tests differential on CPU.
+_scatter_rows_pd = platform_donated_jit(_scatter_rows_traced,
+                                        donate_argnums=(0,))
 
 
 def _scatter_rows(level: jnp.ndarray, idx: jnp.ndarray,
                   rows: jnp.ndarray) -> jnp.ndarray:
-    """level.at[idx].set(rows) with the old buffer donated on accelerator
-    backends: the update rewrites the resident level in place instead of
-    copying O(n) rows. XLA:CPU keeps the undonated (copying) form — CPU
-    executables deserialized from the persistent compilation cache have
-    been observed to violate donated input/output aliasing (see
-    epoch_soa.epoch_transition_device), and tests differential on CPU."""
-    fn = (_scatter_rows_undonated if jax.default_backend() == "cpu"
-          else _scatter_rows_donated)
-    return fn(level, idx, rows)
+    return _scatter_rows_pd(level, idx, rows)
 
 
 def _zero_rows(depth: int, k: int) -> jnp.ndarray:

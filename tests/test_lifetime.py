@@ -1,0 +1,536 @@
+"""tools/analysis/lifetime — the buffer-lifetime tier (CSA1501-1505).
+
+Fixture snippets per rule (positive, negative, suppressed), the
+interprocedural paths (from-imports, call summaries, factories,
+dispatch wrappers), the PR 3 cols-reuse regression and the firehose
+double-in-flight shape, the baseline loosen/tighten/missing/stale
+workflow, and the multi-tier CLI contract (merged --json, max exit).
+
+The prover itself is pure AST interpretation (lower=False throughout);
+only the platform_donated_jit runtime checks import jax.
+"""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from tools.analysis.core import load_baseline, write_baseline
+from tools.analysis.lifetime.engine import run_lifetime
+
+REPO = Path(__file__).resolve().parent.parent
+
+DONOR = (
+    "import jax\n"
+    "from functools import partial\n"
+    "@partial(jax.jit, donate_argnums=(0,))\n"
+    "def consume(x, y):\n"
+    "    return x + y\n"
+)
+
+
+def report_for(tmp_path, source, name="snippet.py"):
+    path = tmp_path / name
+    path.write_text(source)
+    return run_lifetime(targets=[str(path)], baseline={}, lower=False)
+
+
+def rules_of(report):
+    return sorted(f.rule for f in report.findings)
+
+
+def only(report, rule):
+    return [f for f in report.findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# CSA1501 use-after-donate
+# ---------------------------------------------------------------------------
+
+def test_csa1501_use_after_donate_trips(tmp_path):
+    src = DONOR + (
+        "def step(cols, y):\n"
+        "    out = consume(cols, y)\n"
+        "    return cols + out\n"
+    )
+    hits = only(report_for(tmp_path, src), "CSA1501")
+    assert len(hits) == 1 and "`cols`" in hits[0].message
+
+
+def test_csa1501_rebind_chaining_is_clean(tmp_path):
+    src = DONOR + (
+        "def step(cols, y):\n"
+        "    cols = consume(cols, y)\n"
+        "    return cols + 1\n"
+    )
+    assert not only(report_for(tmp_path, src), "CSA1501")
+
+
+def test_csa1501_metadata_reads_stay_legal(tmp_path):
+    # jax keeps the aval on a deleted array: .shape/.dtype reads are fine
+    src = DONOR + (
+        "def step(cols, y):\n"
+        "    out = consume(cols, y)\n"
+        "    n = cols.shape[0] + cols.dtype.itemsize\n"
+        "    return out, n\n"
+    )
+    assert not only(report_for(tmp_path, src), "CSA1501")
+
+
+def test_csa1501_field_read_through_donated_root_trips(tmp_path):
+    # donating `cols` kills `cols.balance` too (prefix coverage)
+    src = DONOR + (
+        "def step(cols, y):\n"
+        "    out = consume(cols, y)\n"
+        "    z = cols.balance + out\n"
+        "    return z\n"
+    )
+    assert len(only(report_for(tmp_path, src), "CSA1501")) == 1
+
+
+def test_csa1501_crosses_from_import(tmp_path):
+    (tmp_path / "kern.py").write_text(DONOR)
+    (tmp_path / "caller.py").write_text(
+        "from kern import consume\n"
+        "def step(cols, y):\n"
+        "    out = consume(cols, y)\n"
+        "    return cols + out\n"
+    )
+    report = run_lifetime(targets=[str(tmp_path)], baseline={},
+                          lower=False)
+    hits = only(report, "CSA1501")
+    assert len(hits) == 1 and hits[0].path.endswith("caller.py")
+
+
+def test_csa1501_call_summary_propagates(tmp_path):
+    # a plain helper that forwards into the donor carries its donation
+    src = DONOR + (
+        "def forward(buf, y):\n"
+        "    return consume(buf, y)\n"
+        "def step(cols, y):\n"
+        "    out = forward(cols, y)\n"
+        "    return cols + out\n"
+    )
+    assert len(only(report_for(tmp_path, src), "CSA1501")) == 1
+
+
+def test_csa1501_factory_return_summary(tmp_path):
+    # `fn = make(); fn(cols, y)` resolves through the return summary
+    src = DONOR + (
+        "def make():\n"
+        "    return consume\n"
+        "def step(cols, y):\n"
+        "    fn = make()\n"
+        "    out = fn(cols, y)\n"
+        "    return cols + out\n"
+    )
+    assert len(only(report_for(tmp_path, src), "CSA1501")) == 1
+
+
+def test_csa1501_suppression_honored(tmp_path):
+    src = DONOR + (
+        "def step(cols, y):\n"
+        "    out = consume(cols, y)\n"
+        "    return cols + out  # csa: ignore[CSA1501] proven host copy\n"
+    )
+    report = report_for(tmp_path, src)
+    assert not only(report, "CSA1501")
+    assert any(f.rule == "CSA1501" for f in report.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# CSA1502 donated-value escape
+# ---------------------------------------------------------------------------
+
+def test_csa1502_attribute_escape_trips(tmp_path):
+    src = DONOR + (
+        "class Holder:\n"
+        "    def step(self, y):\n"
+        "        consume(self._ring, y)\n"
+        "        return y\n"
+    )
+    hits = only(report_for(tmp_path, src), "CSA1502")
+    assert len(hits) == 1 and "self._ring" in hits[0].message
+
+
+def test_csa1502_same_statement_rebind_is_clean(tmp_path):
+    # the firehose idiom: the attribute takes the call's output
+    src = DONOR + (
+        "class Holder:\n"
+        "    def step(self, y):\n"
+        "        self._ring = consume(self._ring, y)\n"
+        "        return y\n"
+    )
+    assert not only(report_for(tmp_path, src), "CSA1502")
+
+
+def test_csa1502_return_of_donated_trips(tmp_path):
+    src = DONOR + (
+        "def step(cols, y):\n"
+        "    out = consume(cols, y)\n"
+        "    return cols\n"
+    )
+    hits = only(report_for(tmp_path, src), "CSA1502")
+    assert len(hits) == 1 and "escapes" in hits[0].message
+
+
+def test_csa1502_return_dispatch_handoff_is_clean(tmp_path):
+    # `return dispatch(..., self.cols, ...)` hands ownership up — the
+    # documented chaining convention, not an escape
+    src = DONOR + (
+        "class Holder:\n"
+        "    def step(self, y):\n"
+        "        return dispatch('k', consume, self.cols, y)\n"
+    )
+    assert not report_for(tmp_path, src).findings or \
+        not only(report_for(tmp_path, src), "CSA1502")
+
+
+def test_csa1502_local_subscript_donation_is_not_an_escape(tmp_path):
+    # donating `single[0]` as its final use: the tuple is frame-local,
+    # the stale handle dies here (the test_multichip shape)
+    src = DONOR + (
+        "def step(cols, y):\n"
+        "    single = (consume(cols, y), y)\n"
+        "    out = consume(single[0], single[1])\n"
+        "    return out\n"
+    )
+    assert not only(report_for(tmp_path, src), "CSA1502")
+
+
+# ---------------------------------------------------------------------------
+# CSA1503 double-in-flight
+# ---------------------------------------------------------------------------
+
+def test_csa1503_double_in_flight_trips(tmp_path):
+    src = DONOR + (
+        "def overlap(buf, y):\n"
+        "    a = dispatch('k1', consume, buf, y)\n"
+        "    b = dispatch('k2', consume, buf, y)\n"
+        "    return a, b\n"
+    )
+    hits = only(report_for(tmp_path, src), "CSA1503")
+    assert len(hits) == 1 and "in flight" in hits[0].message
+
+
+def test_csa1503_materialization_fence_clears(tmp_path):
+    src = DONOR + (
+        "def fenced(buf, y):\n"
+        "    a = dispatch('k1', consume, buf, y)\n"
+        "    a.block_until_ready()\n"
+        "    b = dispatch('k2', consume, buf, y)\n"
+        "    return a, b\n"
+    )
+    report = report_for(tmp_path, src)
+    assert not only(report, "CSA1503")
+
+
+def test_csa1503_double_buffer_rotation_is_clean(tmp_path):
+    # each launch owns its own buffer — the firehose rotation
+    src = DONOR + (
+        "def rotate(front, back, y):\n"
+        "    a = dispatch('k1', consume, front, y)\n"
+        "    b = dispatch('k2', consume, back, y)\n"
+        "    return a, b\n"
+    )
+    assert not only(report_for(tmp_path, src), "CSA1503")
+
+
+def test_csa1503_firehose_ring_shape(tmp_path):
+    # the PR 15 hazard: one ring reaching two wrapper dispatches before
+    # any materialization point, attribute-rooted
+    src = DONOR + (
+        "class Firehose:\n"
+        "    def flush_twice(self, y):\n"
+        "        self._ring = dispatch('a', consume, self._ring, y)\n"
+        "        bad = dispatch('b', consume, self._ring, y)\n"
+        "        return bad\n"
+    )
+    # the rebound ring is LIVE again after the first statement, so the
+    # clean rotation passes; re-donating the SAME pre-rebind handle trips
+    assert not only(report_for(tmp_path, src), "CSA1503")
+    src_bad = DONOR + (
+        "class Firehose:\n"
+        "    def flush_twice(self, y):\n"
+        "        a = dispatch('a', consume, self._ring, y)\n"
+        "        b = dispatch('b', consume, self._ring, y)\n"
+        "        return a, b\n"
+    )
+    assert len(only(report_for(tmp_path, src_bad), "CSA1503")) == 1
+
+
+# ---------------------------------------------------------------------------
+# CSA1504 missing platform guard
+# ---------------------------------------------------------------------------
+
+def test_csa1504_unguarded_jit_warns(tmp_path):
+    hits = only(report_for(tmp_path, DONOR), "CSA1504")
+    assert len(hits) == 1 and "platform guard" in hits[0].message
+
+
+def test_csa1504_platform_helper_is_blessed(tmp_path):
+    src = (
+        "from consensus_specs_tpu.utils.donation import "
+        "platform_donated_jit\n"
+        "def _k(x, y):\n"
+        "    return x + y\n"
+        "_k_pd = platform_donated_jit(_k, donate_argnums=(0,))\n"
+    )
+    assert not only(report_for(tmp_path, src), "CSA1504")
+
+
+def test_csa1504_conditional_donate_is_guarded(tmp_path):
+    src = (
+        "import jax\n"
+        "def _k(x, y):\n"
+        "    return x + y\n"
+        "_kj = jax.jit(_k, donate_argnums=(0,) "
+        "if jax.default_backend() != 'cpu' else ())\n"
+    )
+    assert not only(report_for(tmp_path, src), "CSA1504")
+
+
+# ---------------------------------------------------------------------------
+# CSA1505 redundant copy
+# ---------------------------------------------------------------------------
+
+def test_csa1505_copy_into_undonated_position_notices(tmp_path):
+    src = DONOR + (
+        "def step(cols, y):\n"
+        "    out = consume(cols, y.copy())\n"
+        "    return out\n"
+    )
+    hits = only(report_for(tmp_path, src), "CSA1505")
+    assert len(hits) == 1 and "pure overhead" in hits[0].message
+
+
+def test_csa1505_copy_into_donated_position_is_justified(tmp_path):
+    src = DONOR + (
+        "def step(cols, y):\n"
+        "    out = consume(cols.copy(), y)\n"
+        "    return out, cols\n"
+    )
+    assert not only(report_for(tmp_path, src), "CSA1505")
+
+
+# ---------------------------------------------------------------------------
+# regressions: the PR 3 epoch shape, the resident recovery loop
+# ---------------------------------------------------------------------------
+
+def test_pr3_cols_reuse_regression(tmp_path):
+    # the original PR 3 bug shape: a factory hands back the donated
+    # epoch program, guarded_dispatch launches it, and the caller then
+    # touches the pre-donation cols
+    src = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))\n"
+        "def _epoch(cfg, cols, scal):\n"
+        "    return cols, scal\n"
+        "def _epoch_jit():\n"
+        "    return _epoch\n"
+        "def boundary(cfg, cols, scal):\n"
+        "    out = guarded_dispatch(('k',), _epoch_jit(), cfg, cols, "
+        "scal)\n"
+        "    root = cols.balance\n"
+        "    return out, root\n"
+    )
+    hits = only(report_for(tmp_path, src), "CSA1501")
+    assert len(hits) == 1 and "cols.balance" in hits[0].message
+
+
+def test_pr3_chained_rebind_is_clean(tmp_path):
+    src = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))\n"
+        "def _epoch(cfg, cols, scal):\n"
+        "    return cols, scal\n"
+        "def boundary(cfg, cols, scal):\n"
+        "    cols, scal = _epoch(cfg, cols, scal)\n"
+        "    return cols.balance\n"
+    )
+    assert not only(report_for(tmp_path, src), "CSA1501")
+
+
+def test_resident_recovery_loop_platform_guard_absolves(tmp_path):
+    # the resident retry shape: a conditional donor dispatched inside
+    # try/while; the except arm raises OUT of the donating world before
+    # the loop retries, so the CPU-world retry reads are legal
+    src = (
+        "import jax\n"
+        "from consensus_specs_tpu.utils.donation import "
+        "platform_donated_jit\n"
+        "def _k(cols, y):\n"
+        "    return cols + y\n"
+        "_pd = platform_donated_jit(_k, donate_argnums=(0,))\n"
+        "class Loop:\n"
+        "    def run(self, y):\n"
+        "        while True:\n"
+        "            try:\n"
+        "                return dispatch('k', _pd.resolve(), "
+        "self.cols, y)\n"
+        "            except RuntimeError as exc:\n"
+        "                if jax.default_backend() != 'cpu':\n"
+        "                    raise\n"
+    )
+    report = report_for(tmp_path, src)
+    assert not report.findings, rules_of(report)
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow: loosen / tighten / missing / stale
+# ---------------------------------------------------------------------------
+
+def test_baseline_loosen_tighten_missing_stale(tmp_path):
+    src = DONOR + (
+        "def step(cols, y):\n"
+        "    out = consume(cols, y)\n"
+        "    return cols + out\n"
+    )
+    path = tmp_path / "snippet.py"
+    path.write_text(src)
+    # missing baseline: every finding actionable
+    r1 = run_lifetime(targets=[str(path)], baseline={}, lower=False)
+    assert r1.findings
+    # loosen: write the baseline, findings become baselined
+    bpath = tmp_path / "b.json"
+    write_baseline(str(bpath), r1.findings)
+    accepted = load_baseline(str(bpath))
+    r2 = run_lifetime(targets=[str(path)], baseline=accepted,
+                      lower=False)
+    assert not r2.findings
+    assert sorted(f.rule for f in r2.baselined) == rules_of(r1)
+    # tighten: fix the code, the entries go stale (the ratchet's cue)
+    path.write_text(DONOR + (
+        "def step(cols, y):\n"
+        "    cols = consume(cols, y)\n"
+        "    return cols\n"
+    ))
+    r3 = run_lifetime(targets=[str(path)], baseline=accepted,
+                      lower=False)
+    assert not r3.findings
+    assert len(r3.stale_baseline) >= 1
+
+
+# ---------------------------------------------------------------------------
+# the committed tree proves clean
+# ---------------------------------------------------------------------------
+
+def test_committed_repo_proves_clean():
+    report = run_lifetime(lower=False)
+    assert not report.findings, [
+        (f.path, f.line, f.rule, f.message) for f in report.findings]
+    # the retrofitted platform_donated_jit sites are visible as donors
+    assert report.donors >= 4
+    assert report.files_checked > 50
+
+
+def test_default_baseline_is_committed_and_empty():
+    bpath = REPO / "tools" / "analysis" / "lifetime_baseline.json"
+    data = json.loads(bpath.read_text())
+    assert data["version"] == 1
+    assert data["entries"] == []
+
+
+# ---------------------------------------------------------------------------
+# CLI: four-tier --list-rules, merged multi-tier --json, max exit
+# ---------------------------------------------------------------------------
+
+def test_list_rules_spans_four_tiers():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, check=True).stdout
+    for probe in ("CSA101", "CSA1101", "CSA1401", "CSA1501", "CSA1505"):
+        assert probe in out, probe
+
+
+def test_cli_single_tier_json_shape(tmp_path):
+    out = tmp_path / "lifetime.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--lifetime",
+         "--no-lower", "--json", str(out)],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(out.read_text())
+    assert "tiers" not in data           # historical single-tier shape
+    assert data["findings"] == []
+    assert data["donors"] >= 4
+
+
+def test_cli_merged_tiers_json_and_max_exit(tmp_path):
+    # an AST-tier finding (host cast under jit) + a clean lifetime run:
+    # the merged artifact carries both tiers, the exit is the WORST
+    snippet = tmp_path / "bad_ast.py"
+    snippet.write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return int(x)\n"
+    )
+    out = tmp_path / "merged.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", str(snippet),
+         "--lifetime", "--no-lower", "--json", str(out)],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1          # max(ast=1, lifetime=0)
+    data = json.loads(out.read_text())
+    assert sorted(data["tiers"]) == ["ast", "lifetime"]
+    assert data["tiers"]["lifetime"]["findings"] == []
+    assert any(f["rule"] == "CSA102"
+               for f in data["tiers"]["ast"]["findings"])
+
+
+def test_cli_update_lifetime_baseline_roundtrip(tmp_path):
+    # the committed tree is clean, so a refresh writes an EMPTY ratchet
+    bpath = tmp_path / "lb.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis",
+         "--update-lifetime-baseline", "--no-lower",
+         "--lifetime-baseline", str(bpath)],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(bpath.read_text())["entries"] == []
+
+
+# ---------------------------------------------------------------------------
+# the blessed helper itself (runtime, XLA:CPU)
+# ---------------------------------------------------------------------------
+
+def test_platform_donated_jit_runtime_contract():
+    import jax
+    import jax.numpy as jnp
+    from consensus_specs_tpu.utils.donation import platform_donated_jit
+
+    calls = []
+
+    def kern(x, y):
+        calls.append(1)
+        return x + y
+
+    pd = platform_donated_jit(kern, donate_argnums=(0,))
+    # on XLA:CPU the resolved twin is the undonated one (the PR 3
+    # deserialized-donated-aliasing caveat)
+    assert jax.default_backend() == "cpu"
+    assert pd.donate_now() is False
+    assert pd.resolve() is pd.undonated
+    assert pd.resolve() is not pd.donated
+    x = jnp.arange(4, dtype=jnp.int32)
+    out = pd(x, jnp.int32(1))
+    assert out.tolist() == [1, 2, 3, 4]
+    # the undonated twin leaves the input alive even after dispatch
+    assert x.tolist() == [0, 1, 2, 3]
+    # twins are cached jax.jit objects (the retrace watchdog inspects
+    # their compile cache), constructed lazily and exactly once
+    assert pd.undonated is pd.undonated
+    assert pd.donated is pd.donated
+
+
+def test_platform_donated_jit_rejects_missing_donation_args():
+    import pytest
+    from consensus_specs_tpu.utils.donation import platform_donated_jit
+
+    def kern(x, y):
+        return x
+
+    with pytest.raises(AssertionError):
+        platform_donated_jit(kern, donate_argnums=(5,))

@@ -33,9 +33,23 @@ RANGE_CONTRACTS:
   CSA1403 missing loop invariant
   CSA1404 range-snapshot drift vs ranges_baseline.json
 
-Both jax-touching tiers register only their rule catalogs at import
+A fourth, buffer-lifetime tier (tools/analysis/lifetime/) is an
+interprocedural abstract interpreter of device-buffer OWNERSHIP over
+the call-graph IR, cross-checked against the real lowering facts the
+trace tier extracts (tf.aliasing_output donation survival):
+
+  CSA1501 use-after-donate (read/dispatch of a donated value)
+  CSA1502 donated-value escape (attribute store / return of a stale
+          handle)
+  CSA1503 double-in-flight donation (the firehose overlap shape)
+  CSA1504 missing CPU-undonated twin (the PR 3 caveat codified;
+          utils/donation.platform_donated_jit is the blessed pattern)
+  CSA1505 redundant defensive copy before a donation-free program
+
+The jax-touching tiers register only their rule catalogs at import
 (stdlib, for --list-rules on the no-jax lint lane); the tracing and
-interpretation machinery loads lazily behind --trace / --ranges.
+interpretation machinery loads lazily behind --trace / --ranges /
+--lifetime.
 
 The per-module passes run over each file's jit context; trace context
 propagates across module boundaries through the call-graph IR
@@ -59,3 +73,6 @@ from . import trace   # noqa: F401  (registers the trace-tier rule catalog;
 from . import ranges  # noqa: F401  (registers the range-tier rule catalog;
 #                       the interval interpreter lives in ranges/interp.py +
 #                       ranges/engine.py, loaded lazily by --ranges)
+from . import lifetime  # noqa: F401  (registers the lifetime-tier rule
+#                       catalog; the ownership prover lives in
+#                       lifetime/engine.py, loaded lazily by --lifetime)

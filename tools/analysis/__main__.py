@@ -3,11 +3,21 @@
                               [--update-trace-baseline] [--json out]
      python -m tools.analysis --ranges [--ranges-baseline b.json]
                               [--update-ranges-baseline] [--json out]
+     python -m tools.analysis --lifetime [--lifetime-baseline b.json]
+                              [--update-lifetime-baseline] [--no-lower]
+                              [--json out]
 
 Exit status: 0 when every finding is inline-suppressed or baselined,
 1 when actionable findings remain, 2 on usage errors. Stale baseline
 entries (nothing matches them any more) are reported but do not fail the
 run — they are the ratchet's cue to shrink the file.
+
+Tiers compose: any combination of targets (the AST tier), --trace,
+--ranges and --lifetime runs every selected tier in order. With ONE
+tier selected, --json keeps that tier's historical report shape; with
+several, the artifact is one merged document `{"tiers": {name:
+report}}` and the exit status is the WORST tier's (max), so a green
+multi-tier run still means "zero actionable findings anywhere".
 
 `--trace` selects the trace tier (tools/analysis/trace/): instead of
 AST passes over source targets it traces/lowers the real jitted
@@ -22,12 +32,22 @@ shapes via ShapeDtypeStruct — nothing executes) and runs the interval
 abstract interpreter over the jaxprs, proving the declared limb/column
 budgets and wrap semantics and ratcheting the proven intervals against
 tools/analysis/ranges_baseline.json.
+
+`--lifetime` selects the buffer-lifetime tier (tools/analysis/
+lifetime/): an interprocedural abstract interpreter of device-buffer
+ownership (LIVE / DONATED / MAYBE-DONATED) over the call-graph IR,
+cross-checked against the donation annotations that survive the REAL
+lowerings (`tf.aliasing_output`) unless --no-lower skips that jax-
+touching step. Accepted findings ratchet against
+tools/analysis/lifetime_baseline.json.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
+from typing import Optional, Tuple
 
 from . import analyze_paths, load_baseline
 from .core import RULES, render_human, render_json, write_baseline
@@ -40,7 +60,8 @@ def main(argv=None) -> int:
     parser.add_argument("targets", nargs="*",
                         help="files or directories to analyze")
     parser.add_argument("--json", metavar="PATH",
-                        help="also write a JSON report")
+                        help="also write a JSON report (merged across "
+                             "tiers when several are selected)")
     parser.add_argument("--baseline", metavar="PATH",
                         help="baseline file of accepted findings")
     parser.add_argument("--update-baseline", action="store_true",
@@ -54,8 +75,7 @@ def main(argv=None) -> int:
                              "the pass skips with a notice when absent)")
     parser.add_argument("--trace", action="store_true",
                         help="run the trace tier (kernel TRACE_CONTRACTS "
-                             "over real jaxprs/StableHLO) instead of the "
-                             "AST passes")
+                             "over real jaxprs/StableHLO)")
     parser.add_argument("--trace-baseline", metavar="PATH",
                         help="trace-tier metric snapshot (default: "
                              "tools/analysis/trace_baseline.json)")
@@ -65,8 +85,7 @@ def main(argv=None) -> int:
     parser.add_argument("--ranges", action="store_true",
                         help="run the value-range tier (kernel "
                              "RANGE_CONTRACTS through the interval "
-                             "abstract interpreter) instead of the AST "
-                             "passes")
+                             "abstract interpreter)")
     parser.add_argument("--ranges-baseline", metavar="PATH",
                         help="range-tier proven-interval snapshot "
                              "(default: tools/analysis/"
@@ -74,6 +93,19 @@ def main(argv=None) -> int:
     parser.add_argument("--update-ranges-baseline", action="store_true",
                         help="rewrite --ranges-baseline from the proven "
                              "snapshot (implies --ranges)")
+    parser.add_argument("--lifetime", action="store_true",
+                        help="run the buffer-lifetime tier (the "
+                             "interprocedural donation/aliasing prover, "
+                             "CSA15xx)")
+    parser.add_argument("--lifetime-baseline", metavar="PATH",
+                        help="lifetime-tier accepted findings (default: "
+                             "tools/analysis/lifetime_baseline.json)")
+    parser.add_argument("--update-lifetime-baseline", action="store_true",
+                        help="rewrite --lifetime-baseline from current "
+                             "findings (implies --lifetime)")
+    parser.add_argument("--no-lower", action="store_true",
+                        help="lifetime tier: skip the jax lowering "
+                             "cross-check (declared donations trusted)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -81,16 +113,37 @@ def main(argv=None) -> int:
             print(f"{rule.id}  {rule.severity:7s} {rule.summary}")
         return 0
 
+    # every selected tier runs; exit = worst tier, --json merges
+    runs = []   # (tier name, exit code, json text | None)
     if args.trace or args.update_trace_baseline:
-        return _run_trace(args)
-
+        runs.append(("trace",) + _run_trace(args))
     if args.ranges or args.update_ranges_baseline:
-        return _run_ranges(args)
+        runs.append(("ranges",) + _run_ranges(args))
+    if args.lifetime or args.update_lifetime_baseline:
+        runs.append(("lifetime",) + _run_lifetime(args))
+    if args.targets:
+        runs.append(("ast",) + _run_ast(args))
 
-    if not args.targets:
+    if not runs:
         parser.print_usage(sys.stderr)
         return 2
 
+    if args.json:
+        path = Path(args.json)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        if len(runs) == 1:
+            text = runs[0][2]
+            if text is not None:
+                path.write_text(text + "\n")
+        else:
+            merged = {"tiers": {name: (json.loads(text)
+                                       if text is not None else None)
+                                for name, _, text in runs}}
+            path.write_text(json.dumps(merged, indent=2) + "\n")
+    return max(code for _, code, _ in runs)
+
+
+def _run_ast(args) -> Tuple[int, Optional[str]]:
     options = {}
     if args.reference_root:
         options["reference_root"] = args.reference_root
@@ -100,22 +153,19 @@ def main(argv=None) -> int:
     if args.update_baseline:
         if not args.baseline:
             print("--update-baseline requires --baseline", file=sys.stderr)
-            return 2
+            return 2, None
         # keep still-live baselined findings (and their reasons) alongside
         # the new ones; only entries nothing matches any more drop out
         keep = report.findings + report.baselined
         write_baseline(args.baseline, keep, prior=baseline)
         print(f"baseline: wrote {len(keep)} entr(y|ies) to {args.baseline}")
-        return 0
+        return 0, render_json(report)
 
     print(render_human(report))
-    if args.json:
-        Path(args.json).parent.mkdir(parents=True, exist_ok=True)
-        Path(args.json).write_text(render_json(report) + "\n")
-    return 1 if report.findings else 0
+    return (1 if report.findings else 0), render_json(report)
 
 
-def _run_trace(args) -> int:
+def _run_trace(args) -> Tuple[int, Optional[str]]:
     from .trace import engine
     engine.ensure_cpu_devices(8)
     baseline_path = args.trace_baseline or engine.DEFAULT_BASELINE
@@ -138,7 +188,6 @@ def _run_trace(args) -> int:
         remaining = [f for f in report.findings
                      if f.rule not in ("CSA1102", "CSA1103", "CSA1104")]
         if remaining:
-            from .core import RULES
             print("trace-baseline: the refresh does NOT clear these "
                   "(fix the kernel or change its contract):")
             for f in remaining:
@@ -150,13 +199,10 @@ def _run_trace(args) -> int:
         report.findings = remaining
     else:
         print(engine.render_human(report))
-    if args.json:
-        Path(args.json).parent.mkdir(parents=True, exist_ok=True)
-        Path(args.json).write_text(engine.render_json(report) + "\n")
-    return 1 if report.findings else 0
+    return (1 if report.findings else 0), engine.render_json(report)
 
 
-def _run_ranges(args) -> int:
+def _run_ranges(args) -> Tuple[int, Optional[str]]:
     from .ranges import engine
     from .trace.engine import ensure_cpu_devices
     ensure_cpu_devices(8)
@@ -177,7 +223,6 @@ def _run_ranges(args) -> int:
         # survive it — report them NOW, not on the next CI run
         remaining = [f for f in report.findings if f.rule != "CSA1404"]
         if remaining:
-            from .core import RULES
             print("ranges-baseline: the refresh does NOT clear these "
                   "(fix the kernel or change its contract):")
             for f in remaining:
@@ -186,10 +231,26 @@ def _run_ranges(args) -> int:
         report.findings = remaining
     else:
         print(engine.render_human(report))
-    if args.json:
-        Path(args.json).parent.mkdir(parents=True, exist_ok=True)
-        Path(args.json).write_text(engine.render_json(report) + "\n")
-    return 1 if report.findings else 0
+    return (1 if report.findings else 0), engine.render_json(report)
+
+
+def _run_lifetime(args) -> Tuple[int, Optional[str]]:
+    from .lifetime import engine
+    baseline_path = str(args.lifetime_baseline or engine.DEFAULT_BASELINE)
+    baseline = load_baseline(baseline_path)
+    report = engine.run_lifetime(baseline=baseline,
+                                 baseline_path=baseline_path,
+                                 lower=not args.no_lower)
+
+    if args.update_lifetime_baseline:
+        keep = report.findings + report.baselined
+        write_baseline(baseline_path, keep, prior=baseline)
+        print(f"lifetime-baseline: wrote {len(keep)} entr(y|ies) to "
+              f"{baseline_path}")
+        return 0, engine.render_json(report)
+
+    print(engine.render_human(report))
+    return (1 if report.findings else 0), engine.render_json(report)
 
 
 if __name__ == "__main__":
