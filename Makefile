@@ -8,7 +8,7 @@ PYTHON ?= python
 VECTOR_DIR ?= out/vectors
 JUNIT ?= out/test-results.xml
 
-.PHONY: test testall citest citest-cov citest-mainnet lint analyze contracts ranges lifetime vectors vectors-minimal bench bench-cpu multichip telemetry chaos firehose smoke clean
+.PHONY: test testall citest citest-cov citest-mainnet lint analyze contracts ranges lifetime memory vectors vectors-minimal bench bench-cpu multichip telemetry chaos firehose smoke clean
 
 # measured 90.64% on the round-5 full suite; floor set just under so real
 # regressions fail while normal drift doesn't
@@ -111,6 +111,24 @@ lifetime:
 		--lifetime-baseline tools/analysis/lifetime_baseline.json \
 		--json out/lifetime.json
 
+# Memory tier (tools/analysis/memory/): a peak-buffer-liveness abstract
+# interpreter over the REAL jaxprs of the kernels' MEM_CONTRACTS at
+# ceiling shapes (V=10M epoch, 1M-leaf forest, G=128 pairing, firehose
+# steady state) — donation-aware per-eqn live sets prove each kernel's
+# declared HBM budget (CSA1601), per-shard bytes on the 8-device mesh,
+# scaling exponents from probe shapes (CSA1603), and the Pallas VMEM
+# footprint vs the 16 MiB core (CSA1604), cross-checked against
+# compiled.memory_analysis() where XLA reports it and ratcheted against
+# the committed tools/analysis/memory_baseline.json (CSA1602). Traces
+# via ShapeDtypeStruct — no ceiling-sized arrays are ever allocated.
+# Exit 0 = every budget proven. JSON artifact: out/memory.json. Loosen
+# via --update-memory-baseline.
+memory:
+	mkdir -p out
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.analysis --memory \
+		--memory-baseline tools/analysis/memory_baseline.json \
+		--json out/memory.json
+
 # Conformance vectors, both presets (reference: make gen_yaml_tests).
 vectors:
 	$(PYTHON) -m consensus_specs_tpu.generators -o $(VECTOR_DIR)
@@ -161,10 +179,11 @@ chaos:
 firehose:
 	$(PYTHON) tools/firehose_smoke.py
 
-# Quick health check: lint + static analysis (all four tiers) + the
-# fast test modules. `make contracts`, `make ranges` and `make
-# lifetime` ride here so an op-budget, value-range or buffer-lifetime
-# regression fails at smoke time, before any bench run.
+# Quick health check: lint + static analysis (all five tiers) + the
+# fast test modules. `make contracts`, `make ranges`, `make lifetime`
+# and `make memory` ride here so an op-budget, value-range,
+# buffer-lifetime or memory-budget regression fails at smoke time,
+# before any bench run.
 smoke:
 	$(PYTHON) tools/lint.py consensus_specs_tpu tests bench.py __graft_entry__.py tools
 	$(PYTHON) -m tools.analysis --list-rules >/dev/null
@@ -174,8 +193,9 @@ smoke:
 	$(MAKE) contracts
 	$(MAKE) ranges
 	$(MAKE) lifetime
+	$(MAKE) memory
 	$(MAKE) firehose
-	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_trace_contracts.py tests/test_range_contracts.py tests/test_lifetime.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py tests/test_telemetry.py tests/test_resilience.py tests/test_chaos_checkpoint.py tests/test_streaming.py -q -m "not slow"
+	$(PYTHON) -m pytest tests/test_config.py tests/test_ssz.py tests/test_fork_choice.py tests/test_sharding.py tests/test_incremental_merkle.py tests/test_scalar_mul.py tests/test_fq_redc.py tests/test_analysis.py tests/test_trace_contracts.py tests/test_range_contracts.py tests/test_lifetime.py tests/test_memory_contracts.py tests/test_bench_probe.py tests/test_multichip.py tests/test_resident.py tests/test_telemetry.py tests/test_resilience.py tests/test_chaos_checkpoint.py tests/test_streaming.py -q -m "not slow"
 
 clean:
 	rm -rf out .pytest_cache $(VECTOR_DIR)

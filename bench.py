@@ -1698,6 +1698,12 @@ def main():
     # is pure AST interpretation (no lowering here: `make lifetime`
     # does the cross-check).
     record["lifetime"] = _lifetime_snapshot()
+    # ... and the memory-contract snapshot (declared peak-HBM budgets +
+    # the committed liveness baseline and its hash), so a capture records
+    # the memory envelopes its kernels were proven inside. Declaration
+    # reads only — nothing is traced here (`make memory` does the
+    # liveness walk and the compiled cross-check).
+    record["memory"] = _memory_snapshot()
     print(json.dumps(record))
 
 
@@ -1736,6 +1742,20 @@ def _lifetime_snapshot():
                 "files_checked": report.files_checked,
                 "baseline_sha256": digest}
     except Exception as exc:   # a broken prover must not sink a capture
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _memory_snapshot():
+    try:
+        import hashlib
+        from tools.analysis.memory import engine as _mem_engine
+        base = _mem_engine.DEFAULT_BASELINE
+        digest = hashlib.sha256(base.read_bytes()).hexdigest() \
+            if base.exists() else None
+        return {"declared": _mem_engine.declared_snapshot(),
+                "baseline": _mem_engine.load_memory_baseline(),
+                "baseline_sha256": digest}
+    except Exception as exc:   # a broken registry must not sink a capture
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 

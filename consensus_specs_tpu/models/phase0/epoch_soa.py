@@ -1205,11 +1205,10 @@ TRACE_CONTRACTS = [
 # (replaced by exact summaries via `wrap_ok_sources`), and the
 # FAR_FUTURE_EPOCH sentinel add inline-suppressed at its site above.
 
-def _epoch_ranges_build():
+def _epoch_ranges_build(V: int = 10_000_000):
     import jax as _jax
     from . import get_spec
     cfg = EpochConfig.from_spec(get_spec("mainnet"))
-    V = 10_000_000
     S = _jax.ShapeDtypeStruct
     b = S((V,), jnp.bool_)
     u = S((V,), jnp.uint64)
@@ -1254,5 +1253,40 @@ RANGE_CONTRACTS = [
         build=_epoch_ranges_build,
         wrap_ok=("uint64:sub", "uint64:shl"),
         wrap_ok_sources=("ops/intmath.py",),
+    ),
+]
+
+
+# ---------------------------------------------------------------------------
+# Memory contract (tools/analysis/memory/, `make memory`)
+# ---------------------------------------------------------------------------
+# Peak HBM of the WHOLE epoch transition at the 10M-validator mainnet
+# ceiling, modeled by the liveness walk over the same ShapeDtypeStruct
+# trace the range contract uses (nothing allocates 10M-row columns).
+# The resident-boundary donation (ValidatorColumns in-place, the trace
+# tier's donate_min pin) is part of the model: the seven donated [V]
+# columns alias their outputs and count ONCE. The declared budget is
+# the capacity argument ROADMAP item 4's pod-scale path rests on: the
+# single-device peak must clear a 16 GB HBM with the room the serving
+# loop needs, and the scaling probes pin the O(V) order so a V^2 temp
+# (a [V, V] outer product creeping into the reward math) fails loudly.
+# The compiled cross-check runs at a 2^18-validator probe shape — big
+# enough that every [V] buffer dominates alignment slack, small enough
+# that XLA:CPU compiles it in seconds.
+
+def _epoch_mem_build(V: int = 10_000_000):
+    spec = _epoch_ranges_build(V)
+    return dict(fn=spec["fn"], args=spec["args"], donate_argnums=(0,))
+
+
+MEM_CONTRACTS = [
+    dict(
+        name="models.phase0.epoch_soa.epoch_hbm_ceiling",
+        build=_epoch_mem_build,
+        budget_bytes=4 << 30,          # 4 GiB of a 16 GB HBM at V = 10^7
+        scaling=dict(ns=[100_000, 1_000_000, 10_000_000],
+                     build=_epoch_mem_build,
+                     metric="peak_bytes", max_order=1.0),
+        compiled=dict(build=lambda: _epoch_mem_build(1 << 18)),
     ),
 ]

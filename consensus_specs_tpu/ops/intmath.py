@@ -67,7 +67,13 @@ def muldiv_u64(a: jnp.ndarray, b: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
     Restoring division: 128-bit remainder tracked as (overflow-bit, uint64).
     """
     hi, lo = mulwide_u64(a, b)
-    d = jnp.broadcast_to(jnp.asarray(d, dtype=jnp.uint64), hi.shape)
+    # d stays at its natural rank: a scalar divisor rides the 64-step
+    # division loop as a scalar constant instead of a [V]-materialized
+    # one (the memory tier's liveness walk flagged the broadcast_to that
+    # used to sit here as a full-width buffer pinned live across the
+    # whole scan at every scalar-divisor call site — the three
+    # micro-incentive muldivs and the slashing muldiv in epoch_soa).
+    d = jnp.asarray(d, dtype=jnp.uint64)
 
     def step(i, carry):
         rem, quot = carry
@@ -82,7 +88,12 @@ def muldiv_u64(a: jnp.ndarray, b: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
 
     # Seed the remainder with the high word reduced mod d (hi < d whenever the
     # quotient fits 64 bits; the mod is free insurance for hi >= d edge cases).
-    rem0 = hi % d
+    # lax.rem, not `hi % d`: jnp's guarded remainder stages a full-width
+    # where(d == 0, 1, d) select plus a sign-correction chain that is dead
+    # for uint64 — d >= 1 is this function's documented precondition, so
+    # the raw remainder is bit-identical (pinned in tests/test_epoch_soa.py)
+    # and the liveness model stops charging ~V*8 B of select temps per call.
+    rem0 = jax.lax.rem(hi, jnp.broadcast_to(d, hi.shape))
     quot0 = jnp.zeros_like(hi)
     _, quot = jax.lax.fori_loop(0, 64, step, (rem0, quot0))
     return quot

@@ -156,6 +156,40 @@ _pairs_transposed_jit = jax.jit(
     _pairs_transposed, static_argnames=("block_lanes", "interpret"))
 
 
+def vmem_block_model(block_lanes: int = 512):
+    """(shape, dtype) rows of one grid step's VMEM residency, built
+    from the SAME BlockSpecs `_pairs_transposed` hands pallas_call (the
+    [16, BN] message tile, the [8, BN] digest tile, and the interpret
+    path's two [64] schedule tables — the superset, so the bound covers
+    both kernel forms). The memory tier's CSA1604 contract multiplies
+    these by the pipeline's double buffering against the 16 MiB/core
+    budget; reading `.block_shape` off real BlockSpec objects keeps the
+    bound tracking the kernel, not a transcription of it."""
+    w_spec = pl.BlockSpec((16, block_lanes), lambda i: (0, i))
+    out_spec = pl.BlockSpec((8, block_lanes), lambda i: (0, i))
+    table = pl.BlockSpec((64,), lambda i: (0,))
+    return [(tuple(s.block_shape), "uint32")
+            for s in (w_spec, out_spec, table, table)]
+
+
+# ---------------------------------------------------------------------------
+# Memory contract (tools/analysis/memory/, `make memory`)
+# ---------------------------------------------------------------------------
+# The VMEM footprint of the default block_lanes=512 tile under the
+# double-buffered grid pipeline: (16 + 8) x 512 x 4 B tiles plus the
+# two 64-entry schedule tables, x2 buffering — ~97 KiB of the 16 MiB
+# core, leaving the headroom the ROADMAP item-3 REDC kernel will share.
+# A block_lanes bump (or a dtype widening in the tile) that escapes the
+# budget fails here before Mosaic ever sees it.
+
+MEM_CONTRACTS = [
+    dict(
+        name="ops.sha256_pallas.pairs_vmem",
+        vmem=dict(blocks=vmem_block_model, buffering=2),
+    ),
+]
+
+
 def sha256_pairs_pallas(words: jnp.ndarray, *, block_lanes: int = 512,
                         interpret: bool | None = None) -> jnp.ndarray:
     """[N, 16] uint32 big-endian words -> [N, 8] digests; == sha256_pairs.

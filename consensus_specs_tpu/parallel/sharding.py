@@ -474,6 +474,39 @@ def _forest_build_build():
         jit_kwargs=dict(in_shardings=in_sh, out_shardings=out_sh))
 
 
+# ---------------------------------------------------------------------------
+# Memory contract (tools/analysis/memory/, `make memory`)
+# ---------------------------------------------------------------------------
+# The per-shard HBM capacity argument of the sharded epoch at the 10M
+# ceiling, PROVEN rather than hand arithmetic: rerun the liveness walk
+# with the mesh placement policy as the byte function — a leaf with
+# >= 2^20 elements shards over the 8 virtual devices ([V] columns and
+# every [V]-sized intermediate; epoch_shardings places them on "v"),
+# anything smaller replicates (scalars, the LATEST_SLASHED_EXIT_LENGTH
+# table, the SHARD_COUNT aggregates; `replicated` placement) — and
+# check shard_peak <= ceil(single_peak / 8) + the declared replicated
+# cap. The cap bounds the replicated remainder (small tables + scalar
+# reductions live at the peak eqn): 1 MiB of slack vs the ~200 MB
+# per-shard column footprint, so a [V] buffer silently dropping out of
+# the sharded set (a placement regression re-materializing a full
+# column per device) overshoots it by orders of magnitude.
+
+def _mesh_epoch_mem_build():
+    from ..models.phase0.epoch_soa import _epoch_mem_build
+    return _epoch_mem_build()
+
+
+MEM_CONTRACTS = [
+    dict(
+        name="parallel.sharding.epoch_shard_hbm",
+        build=_mesh_epoch_mem_build,
+        sharded=dict(devices=_CONTRACT_MESH_DEVICES,
+                     min_elems=1 << 20,
+                     replicated_cap_bytes=1 << 20),
+    ),
+]
+
+
 TRACE_CONTRACTS = [
     dict(
         name="parallel.sharding.mesh_epoch_chain",

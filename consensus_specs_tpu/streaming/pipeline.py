@@ -264,6 +264,61 @@ def _ring_scatter_build():
         jit_kwargs={"donate_argnums": (0,)})
 
 
+# ---------------------------------------------------------------------------
+# Memory contract (tools/analysis/memory/, `make memory`)
+# ---------------------------------------------------------------------------
+# The steady-state firehose working set as ONE modeled program: the
+# verdict ring (donated — it aliases its output and counts once, the
+# in-place update the class dispatches through platform_donated_jit)
+# plus TWO in-flight batches at the committed G = 128 x P = 3 shape —
+# batch A resident through pairing -> verdict -> ring scatter while
+# batch B's staged arrays and Miller accumulators overlap it, exactly
+# the double-buffer overlap dispatch() sustains. The budget is the
+# figure the firehose bench's sustained-load acceptance rests on: the
+# ring never grows, the per-batch buffers turn over, and a second
+# resident copy of a batch (a defensive clone of the staged arrays
+# creeping into dispatch) blows the modeled peak past it.
+
+def _firehose_steady_mem_build(g: int = _FIREHOSE_G):
+    import jax as _jax
+    import jax.numpy as jnp
+    from ..ops import bls_jax as BJ
+    from ..ops import fq as F
+    S = _jax.ShapeDtypeStruct
+    g1 = S((g, _FIREHOSE_P, 2, F.L), jnp.int64)
+    g2 = S((g, _FIREHOSE_P, 2, 2, F.L), jnp.int64)
+
+    def steady(ring, start, g1a, g2a, g1b, g2b):
+        fa = BJ.miller_loop_grouped(g1a, g2a)     # batch A: pairing
+        va = BJ._grouped_verdict(fa)              # batch A: verdict
+        ring = _ring_scatter(ring, va, start)     # A lands in the ring
+        fb = BJ.miller_loop_grouped(g1b, g2b)     # batch B overlaps
+        return ring, fb
+
+    return dict(fn=steady,
+                args=(S((1024,), jnp.bool_), S((), jnp.int32),
+                      g1, g2, g1, g2),
+                donate_argnums=(0,),
+                context=lambda: F.pinned_fq_redc_backend("coeff"))
+
+
+# No standing `compiled` probe: the steady-state program embeds two
+# unrolled Miller loops, which XLA:CPU compiles in ~4 minutes apiece
+# even at tiny g (see the matching note on ops/bls_jax.MEM_CONTRACTS,
+# whose g=4 probe agreed with the model out-of-band); the trace-based
+# budget check below is the standing gate.
+MEM_CONTRACTS = [
+    dict(
+        name="streaming.pipeline.firehose_steady_state",
+        build=_firehose_steady_mem_build,
+        # modeled steady-state peak ~7.6 MiB (ring + verdict fold of
+        # batch A live across batch B's Miller accumulator): 16 MiB is
+        # a real ceiling — a second resident batch copy trips it
+        budget_bytes=16 << 20,
+    ),
+]
+
+
 TRACE_CONTRACTS = [
     dict(
         name=f"streaming.pipeline.firehose_miller[{mode}]",

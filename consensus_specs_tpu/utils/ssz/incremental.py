@@ -101,6 +101,37 @@ def _build_levels(leaf_words: jnp.ndarray):
     return tuple(levels)
 
 
+def _update_paths_traced(levels, rows, idx: np.ndarray):
+    """Pure single-program twin of `update()` with a STATIC dirty set:
+    the scatter plus every level's path re-hash in one traceable
+    function (the instance method interleaves host bookkeeping and
+    per-level launches; this form exists so the memory tier can model
+    the whole update's liveness and O(dirty * log V) byte order over
+    one jaxpr). Same gather/zerohash/scatter sequence as
+    `_rehash_paths`, minus the lane accounting."""
+    levels = list(levels)
+    idx = np.asarray(idx, np.int32).reshape(-1)
+    levels[0] = _scatter_rows_traced(levels[0], jnp.asarray(idx), rows)
+    dirty = np.unique(idx)
+    for d in range(len(levels) - 1):
+        parents = np.unique(dirty >> 1)
+        lanes = _pad_pow2_indices(parents)
+        level = levels[d]
+        n_d = level.shape[0]
+        left = level[jnp.asarray(lanes * 2)]
+        ri = lanes * 2 + 1
+        right = level[jnp.asarray(np.minimum(ri, n_d - 1))]
+        virtual = ri >= n_d
+        if virtual.any():
+            right = jnp.where(jnp.asarray(virtual)[:, None],
+                              _zero_rows(d, 1), right)
+        digests = pair_hash_words(jnp.concatenate([left, right], axis=1))
+        levels[d + 1] = _scatter_rows_traced(levels[d + 1],
+                                             jnp.asarray(lanes), digests)
+        dirty = parents
+    return tuple(levels)
+
+
 def _pad_pow2_indices(idx: np.ndarray) -> np.ndarray:
     """Pad an index vector to the next power of two by repeating its last
     entry (bounds jit-cache shapes; duplicates are harmless for gather and
@@ -423,5 +454,64 @@ TRACE_CONTRACTS = [
         measure=_forest_lane_measure,
         budgets={"build_pair_lanes": 63, "update_pair_lanes": 11},
         exact=("build_pair_lanes", "update_pair_lanes"),
+    ),
+]
+
+
+# ---------------------------------------------------------------------------
+# Memory contracts (tools/analysis/memory/, `make memory`)
+# ---------------------------------------------------------------------------
+# The 2^20-leaf forest (a 1M-validator registry's chunk tree): the full
+# build's peak is every level live at once (Sum n/2^d = 2n rows of 32 B)
+# plus the pair-hash transients — O(V), pinned by the capacity probes —
+# and an update's bytes beyond the donated-and-aliased level buffers
+# (counted ONCE, the donation the class performs through
+# platform_donated_jit) are the gathered children, the schedule windows
+# and the digests of the dirty root paths: O(dirty * log V), pinned by
+# the dirty-count probes at a fixed 2^16 capacity. A kernel change that
+# re-hashes a whole level on update (the regression the trace tier's
+# lane pin also guards) breaks the scaling fit, not just the ratchet.
+
+def _forest_build_mem_build(v: int = 1 << 20):
+    import jax as _jax
+    return dict(fn=_build_levels,
+                args=(_jax.ShapeDtypeStruct((v, 8), jnp.uint32),))
+
+
+def _forest_update_mem_build(v: int = 1 << 20, dirty: int = 64):
+    import jax as _jax
+    S = _jax.ShapeDtypeStruct
+    levels = tuple(S((max(v >> d, 1), 8), jnp.uint32)
+                   for d in range(tree_depth(v) + 1))
+    rng = np.random.default_rng(7)
+    idx = np.sort(rng.choice(v, size=dirty, replace=False)).astype(np.int32)
+    return dict(
+        fn=lambda lv, rows: _update_paths_traced(lv, rows, idx),
+        args=(levels, S((dirty, 8), jnp.uint32)),
+        donate_argnums=(0,))
+
+
+MEM_CONTRACTS = [
+    dict(
+        name="utils.ssz.incremental.forest_build_1m",
+        build=_forest_build_mem_build,
+        # all levels live at once (2n rows) plus the leaf level's sha256
+        # schedule windows, which the no-fusion model counts at full
+        # width (XLA fuses most of them — hence the wider compiled
+        # tolerance below: model/compiled = ~1.4x at the probe shape)
+        budget_bytes=384 << 20,
+        scaling=dict(ns=[1 << 14, 1 << 17, 1 << 20],
+                     build=_forest_build_mem_build,
+                     metric="peak_bytes", max_order=1.0),
+        compiled=dict(build=lambda: _forest_build_mem_build(1 << 12),
+                      tol=1.5),
+    ),
+    dict(
+        name="utils.ssz.incremental.forest_update_dirty",
+        build=_forest_update_mem_build,
+        scaling=dict(ns=[8, 64, 512],
+                     build=lambda d: _forest_update_mem_build(1 << 16, d),
+                     metric="temp_bytes", max_order=1.0, tol=0.2),
+        compiled=dict(build=lambda: _forest_update_mem_build(1 << 12, 16)),
     ),
 ]

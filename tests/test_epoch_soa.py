@@ -192,3 +192,82 @@ def test_wide_math_helpers_exact():
     import math
     want = np.array([math.isqrt(n) for n in ns], dtype=np.uint64)
     np.testing.assert_array_equal(got, want)
+
+
+def test_muldiv_hardened_vs_materializing_form():
+    """The memory tier's liveness walk flagged two full-width temps in
+    muldiv_u64: a broadcast_to that pinned scalar divisors at [V] width
+    across the whole 64-step division scan, and jnp's guarded `%` whose
+    where(d == 0) select chain is dead under the documented d >= 1
+    precondition. This pins the hardened body bit-identical to the old
+    materializing formulation — scalar AND vector divisors — and pins
+    the prover win itself: a scalar divisor must never re-enter the
+    division loop as a full-width constant."""
+    import jax
+    import jax.numpy as jnp
+    from consensus_specs_tpu.ops.intmath import muldiv_u64, mulwide_u64
+
+    def muldiv_materializing(a, b, d):
+        # the pre-hardening body, verbatim modulo names
+        hi, lo = mulwide_u64(a, b)
+        d = jnp.broadcast_to(jnp.asarray(d, dtype=jnp.uint64), hi.shape)
+
+        def step(i, carry):
+            rem, quot = carry
+            shift = jnp.uint64(63) - jnp.asarray(i, dtype=jnp.uint64)
+            bit = (lo >> shift) & jnp.uint64(1)
+            top = rem >> jnp.uint64(63)
+            rem2 = (rem << jnp.uint64(1)) | bit
+            ge = (top == jnp.uint64(1)) | (rem2 >= d)
+            rem3 = jnp.where(ge, rem2 - d, rem2)
+            quot2 = (quot << jnp.uint64(1)) | ge.astype(jnp.uint64)
+            return rem3, quot2
+
+        rem0 = hi % d
+        quot0 = jnp.zeros_like(hi)
+        _, quot = jax.lax.fori_loop(0, 64, step, (rem0, quot0))
+        return quot
+
+    rng = random.Random(1601)
+    n = 512
+    a = np.array([rng.randrange(0, 1 << 64) for _ in range(n)], np.uint64)
+    dv = np.array([rng.randrange(1, 1 << 63) for _ in range(n)], np.uint64)
+    b = np.array([rng.randrange(0, int(x) + 1) for x in dv], np.uint64)
+    ja, jb, jd = (jnp.asarray(x) for x in (a, b, dv))
+    # vector divisor (the crosslink-delta shape)
+    np.testing.assert_array_equal(np.asarray(muldiv_u64(ja, jb, jd)),
+                                  np.asarray(muldiv_materializing(ja, jb, jd)))
+    # scalar divisor (the micro-incentive / slashing shape), d = 1 edge too
+    for d_scalar in (jnp.uint64(3 * 10 ** 16 + 1), jnp.uint64(1)):
+        bs = jnp.minimum(jb, d_scalar)
+        np.testing.assert_array_equal(
+            np.asarray(muldiv_u64(ja, bs, d_scalar)),
+            np.asarray(muldiv_materializing(ja, bs, d_scalar)))
+
+    # the prover's claim, pinned structurally: in the scalar-divisor
+    # jaxpr the division loop's carried/constant operands contain ONE
+    # full-width uint64 stream (lo) beyond the two carries — the old
+    # body carried the broadcast divisor as a second full-width const
+    closed = jax.make_jaxpr(
+        lambda x, y: muldiv_u64(x, y, jnp.uint64(7)))(ja, jb)
+
+    def loop_consts(jaxpr):
+        found = []
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name in ("while", "scan"):
+                found.append([tuple(v.aval.shape) for v in eqn.invars
+                              if getattr(v, "aval", None) is not None])
+            for val in eqn.params.values():
+                for item in (val if isinstance(val, (tuple, list)) else (val,)):
+                    if hasattr(item, "jaxpr"):
+                        found.extend(loop_consts(
+                            getattr(item.jaxpr, "jaxpr", item.jaxpr)))
+        return found
+
+    loops = loop_consts(closed.jaxpr)
+    assert loops, "division loop vanished from muldiv_u64's jaxpr"
+    full_width = max(sum(1 for shp in ops if shp == (n,)) for ops in loops)
+    assert full_width <= 3, (
+        f"scalar-divisor muldiv carries {full_width} full-width loop "
+        f"operands (expected lo + rem + quot): the divisor is being "
+        f"materialized again")

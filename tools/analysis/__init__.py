@@ -46,10 +46,23 @@ trace tier extracts (tf.aliasing_output donation survival):
           utils/donation.platform_donated_jit is the blessed pattern)
   CSA1505 redundant defensive copy before a donation-free program
 
+A fifth, memory tier (tools/analysis/memory/) is an abstract
+interpreter of peak BUFFER LIVENESS over the real jaxprs at ceiling
+shapes (10M-validator epoch, the 2^20-leaf forest, the G=128 grouped
+pairing), cross-checked against compiled.memory_analysis() and the
+8-device per-shard bound, with a bytes ratchet and a Pallas VMEM
+budget:
+
+  CSA1601 declared-budget violation (peak/shard bound/compiled check)
+  CSA1602 memory-snapshot drift vs memory_baseline.json (bytes ratchet)
+  CSA1603 superlinear memory scaling vs the declared order
+  CSA1604 Pallas VMEM overflow (BlockSpec x dtype x buffering)
+  CSA1605 host round-trip widening live buffer ranges (notice)
+
 The jax-touching tiers register only their rule catalogs at import
 (stdlib, for --list-rules on the no-jax lint lane); the tracing and
 interpretation machinery loads lazily behind --trace / --ranges /
---lifetime.
+--lifetime / --memory.
 
 The per-module passes run over each file's jit context; trace context
 propagates across module boundaries through the call-graph IR
@@ -76,3 +89,7 @@ from . import ranges  # noqa: F401  (registers the range-tier rule catalog;
 from . import lifetime  # noqa: F401  (registers the lifetime-tier rule
 #                       catalog; the ownership prover lives in
 #                       lifetime/engine.py, loaded lazily by --lifetime)
+from . import memory  # noqa: F401  (registers the memory-tier rule
+#                       catalog; the liveness interpreter lives in
+#                       memory/liveness.py + memory/engine.py, loaded
+#                       lazily by --memory)

@@ -6,6 +6,9 @@
      python -m tools.analysis --lifetime [--lifetime-baseline b.json]
                               [--update-lifetime-baseline] [--no-lower]
                               [--json out]
+     python -m tools.analysis --memory [--memory-baseline b.json]
+                              [--update-memory-baseline]
+                              [--memory-filter SUBSTR] [--json out]
 
 Exit status: 0 when every finding is inline-suppressed or baselined,
 1 when actionable findings remain, 2 on usage errors. Stale baseline
@@ -13,7 +16,8 @@ entries (nothing matches them any more) are reported but do not fail the
 run — they are the ratchet's cue to shrink the file.
 
 Tiers compose: any combination of targets (the AST tier), --trace,
---ranges and --lifetime runs every selected tier in order. With ONE
+--ranges, --lifetime and --memory runs every selected tier in order.
+With ONE
 tier selected, --json keeps that tier's historical report shape; with
 several, the artifact is one merged document `{"tiers": {name:
 report}}` and the exit status is the WORST tier's (max), so a green
@@ -40,6 +44,14 @@ cross-checked against the donation annotations that survive the REAL
 lowerings (`tf.aliasing_output`) unless --no-lower skips that jax-
 touching step. Accepted findings ratchet against
 tools/analysis/lifetime_baseline.json.
+
+`--memory` selects the memory tier (tools/analysis/memory/): it traces
+the programs named by the kernels' MEM_CONTRACTS at their ceiling
+shapes (ShapeDtypeStruct — nothing allocates) and walks the jaxprs
+with the peak-liveness interpreter, proving the declared HBM/VMEM byte
+budgets, the per-shard sharding bound and the scaling orders, cross-
+checking the model against compiled.memory_analysis(), and ratcheting
+the modeled bytes against tools/analysis/memory_baseline.json.
 """
 from __future__ import annotations
 
@@ -103,6 +115,23 @@ def main(argv=None) -> int:
     parser.add_argument("--update-lifetime-baseline", action="store_true",
                         help="rewrite --lifetime-baseline from current "
                              "findings (implies --lifetime)")
+    parser.add_argument("--memory", action="store_true",
+                        help="run the memory tier (kernel MEM_CONTRACTS "
+                             "through the peak-liveness interpreter, "
+                             "CSA16xx)")
+    parser.add_argument("--memory-baseline", metavar="PATH",
+                        help="memory-tier modeled-bytes snapshot "
+                             "(default: tools/analysis/"
+                             "memory_baseline.json)")
+    parser.add_argument("--update-memory-baseline", action="store_true",
+                        help="rewrite --memory-baseline from the modeled "
+                             "snapshot (implies --memory)")
+    parser.add_argument("--memory-filter", metavar="SUBSTR",
+                        help="memory tier: only run contracts whose name "
+                             "contains SUBSTR (iteration aid — the "
+                             "pairing traces cost ~1 min each; stale-"
+                             "baseline pruning is disabled on a "
+                             "filtered run)")
     parser.add_argument("--no-lower", action="store_true",
                         help="lifetime tier: skip the jax lowering "
                              "cross-check (declared donations trusted)")
@@ -121,6 +150,8 @@ def main(argv=None) -> int:
         runs.append(("ranges",) + _run_ranges(args))
     if args.lifetime or args.update_lifetime_baseline:
         runs.append(("lifetime",) + _run_lifetime(args))
+    if args.memory or args.update_memory_baseline:
+        runs.append(("memory",) + _run_memory(args))
     if args.targets:
         runs.append(("ast",) + _run_ast(args))
 
@@ -224,6 +255,51 @@ def _run_ranges(args) -> Tuple[int, Optional[str]]:
         remaining = [f for f in report.findings if f.rule != "CSA1404"]
         if remaining:
             print("ranges-baseline: the refresh does NOT clear these "
+                  "(fix the kernel or change its contract):")
+            for f in remaining:
+                print(f"{f.path}:{f.line}: [{f.rule}] "
+                      f"{RULES[f.rule].severity}: {f.context}: {f.message}")
+        report.findings = remaining
+    else:
+        print(engine.render_human(report))
+    return (1 if report.findings else 0), engine.render_json(report)
+
+
+def _run_memory(args) -> Tuple[int, Optional[str]]:
+    from .memory import engine
+    from .trace.engine import ensure_cpu_devices
+    ensure_cpu_devices(8)
+    baseline_path = args.memory_baseline or engine.DEFAULT_BASELINE
+    contracts = None
+    if args.memory_filter:
+        contracts = [c for c in engine.discover()
+                     if args.memory_filter in c["name"]]
+        if not contracts:
+            print(f"memory: no contract name contains "
+                  f"{args.memory_filter!r}", file=sys.stderr)
+            return 2, None
+    report = engine.run_contracts(contracts=contracts,
+                                  baseline_path=baseline_path)
+    if args.memory_filter:
+        # baseline entries outside the filter are unmatched by
+        # construction, not stale — never prune or report them
+        report.stale_baseline = []
+
+    if args.update_memory_baseline:
+        prior = engine.load_memory_baseline(baseline_path)
+        snapshot = dict(prior)
+        snapshot.update(report.snapshot)
+        for name in report.stale_baseline:
+            snapshot.pop(name, None)
+        engine.write_memory_baseline(baseline_path, snapshot)
+        print(f"memory-baseline: wrote {len(snapshot)} contract(s) to "
+              f"{baseline_path}")
+        # the refresh clears only the bytes-ratchet family (CSA1602);
+        # budget/shard/compiled violations, scaling escapes and VMEM
+        # overflows survive it — report them NOW, not on the next CI run
+        remaining = [f for f in report.findings if f.rule != "CSA1602"]
+        if remaining:
+            print("memory-baseline: the refresh does NOT clear these "
                   "(fix the kernel or change its contract):")
             for f in remaining:
                 print(f"{f.path}:{f.line}: [{f.rule}] "

@@ -25,6 +25,37 @@ def sync(x):
     return np.asarray(leaf.ravel()[0:1])
 
 
+def _modeled_traffic_gb(label, fn, *args):
+    """(lo, hi) GB of HBM traffic for `fn(*args)` from the memory
+    tier's cost model (tools/analysis/memory/liveness.py) over the real
+    jaxpr — the roofline's byte denominators, deduped onto the same
+    accounting `make memory` budgets — cross-checked against the bytes
+    the compiled HLO actually allocates. A >2x peak divergence between
+    model and compiled aborts the run: a roofline over an untrusted
+    byte model is noise, not a denominator."""
+    import jax
+    from tools.analysis.memory import liveness as ML
+    closed = jax.make_jaxpr(fn)(*args)
+    lo, hi = ML.traffic_bounds(closed)
+    model = ML.analyze(closed)
+    stats = jax.jit(fn).lower(*args).compile().memory_analysis()
+    if stats is not None:
+        compiled_peak = (int(stats.argument_size_in_bytes)
+                         + int(stats.output_size_in_bytes)
+                         - int(getattr(stats, "alias_size_in_bytes", 0))
+                         + int(stats.temp_size_in_bytes))
+        ratio = (max(model.peak_bytes, compiled_peak)
+                 / max(1, min(model.peak_bytes, compiled_peak)))
+        print(f"[roofline] {label}: modeled peak "
+              f"{model.peak_bytes/1e6:.1f} MB vs compiled HLO "
+              f"{compiled_peak/1e6:.1f} MB (x{ratio:.2f})", flush=True)
+        assert ratio <= 2.0, (
+            f"{label}: liveness model and compiled memory_analysis "
+            f"diverge x{ratio:.2f} (> 2x) — fix the model before "
+            f"trusting this roofline")
+    return lo / 1e9, hi / 1e9
+
+
 class _Stages:
     """Linear stage marker: `stages.next("followup.x")` closes the
     previous stage's telemetry span (printing its wall time + the
@@ -192,12 +223,23 @@ def main():
         np.asarray(p2.ravel()[0:1])
         ts.append(time.perf_counter() - t0)
     t_shuf = max(min(ts) - rtt, 1e-9)
-    # streaming model per round: C reverse+roll (2 passes, 8B rw each),
-    # bits reverse+roll (2 passes, 2B rw), select reads/writes (~14B) —
-    # an UPPER bound of 34 B/elem/round; a perfectly fused lower bound is
-    # ~9 B/elem/round (read C+bits, write C)
-    hi_gb = 34e-9 * Vr * R
-    lo_gb = 9e-9 * Vr * R
+    # traffic bounds from the memory tier's cost model over the REAL
+    # round kernel's jaxpr (tools/analysis/memory/liveness.py — the
+    # same per-eqn byte accounting the MEM_CONTRACTS budgets use),
+    # replacing the hand-maintained B/elem/round table this block used
+    # to carry: `hi` streams every eqn's operands/results (no fusion),
+    # `lo` is the perfectly-fused floor. The model is cross-checked
+    # against what the compiled HLO actually allocates and FAILS on
+    # >2x divergence instead of silently trusting itself.
+    from consensus_specs_tpu.ops.sha256 import bytes_to_words as _b2w
+    from consensus_specs_tpu.ops.shuffle import (_shuffle_rounds_stacked,
+                                                 host_pivots)
+    _sd = bytes(range(32))
+    _sw = jnp.asarray(_b2w(np.frombuffer(_sd, dtype=np.uint8)))
+    _pv = jnp.asarray(host_pivots(_sd, Vr, R))
+    lo_gb, hi_gb = _modeled_traffic_gb(
+        "shuffle rounds", lambda s, p: _shuffle_rounds_stacked(s, p, Vr, R),
+        _sw, _pv)
     hbm_gbs = HBM_PEAK / 1e9   # peak in GB/s (traffic model is in GB)
     print(f"[roofline] shuffle 1M x {R} rounds: {t_shuf*1e3:.1f} ms "
           f"(fence-corrected) | traffic model {lo_gb:.1f}-{hi_gb:.1f} GB -> "
@@ -208,12 +250,7 @@ def main():
 
     # A/B: the stacked-movement variant (one [2, n] reverse+roll per round
     # instead of two; bit-equality pinned in tests/test_shuffle_kernel.py)
-    from consensus_specs_tpu.ops.sha256 import bytes_to_words
-    from consensus_specs_tpu.ops.shuffle import (_shuffle_rounds_stacked,
-                                                 host_pivots)
-    sd = bytes(range(32))
-    sw = jnp.asarray(bytes_to_words(np.frombuffer(sd, dtype=np.uint8)))
-    pv = jnp.asarray(host_pivots(sd, Vr, R))
+    sw, pv = _sw, _pv
     ps = _shuffle_rounds_stacked(sw, pv, Vr, R)
     assert np.array_equal(np.asarray(ps), np.asarray(perm)), \
         "stacked shuffle != reference kernel on TPU"
